@@ -1,0 +1,30 @@
+"""ape_x_dqn_tpu — a TPU-native distributed deep-RL framework.
+
+Brand-new implementation of the Ape-X DQN capability family (reference:
+Jia-Mo/Ape-X-DQN; see SURVEY.md — the reference mount was empty at survey
+time, so parity is built against the driver-attested contract in
+SURVEY.md §2 / BASELINE.json rather than file:line citations):
+
+- parallel actors feeding a prioritized (sum-tree) replay buffer,
+- a learner running n-step double-DQN with dueling Nature-CNN heads as a
+  single jit-compiled XLA graph,
+- the sum-tree living in HBM with device-side stratified sampling,
+- R2D2-style recurrent sequence replay with stored LSTM state,
+- Ape-X DPG continuous control,
+- learner collectives and weight broadcast over ICI via jax.sharding,
+- batched TPU inference serving for actors.
+
+Layout:
+    configs   — dataclass run configurations (the five reference configs)
+    utils/    — rng threading, metrics, checkpointing
+    envs/     — native environments + Atari preprocessing stack
+    models/   — flax Q-networks and actor-critic modules
+    ops/      — losses, device sum-tree primitives, n-step returns
+    replay/   — uniform / prioritized / sequence replay buffers
+    parallel/ — mesh, shardings, collectives, batched inference server
+    comm/     — transport abstraction (loopback queues, sockets for DCN)
+    runtime/  — actor / learner / replay-server / driver orchestration
+    cpp/      — native C++ host components (sum-tree, ingest ring buffer)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,221 @@
+"""Run configuration system.
+
+The reference ships five named configurations (SURVEY.md §2.1, attested by
+BASELINE.json `configs`). Each is a preset here; every field can be
+overridden from the CLI (``runtime/train.py``) or programmatically via
+``dataclasses.replace``.
+
+Hyperparameter defaults follow the published papers the reference
+implements (Horgan et al. 2018 Ape-X; Kapturowski et al. 2019 R2D2;
+Schaul et al. 2016 PER) as recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class EnvConfig:
+    id: str = "CartPole-v1"
+    kind: str = "cartpole"  # cartpole | atari | control | synthetic_atari
+    # Atari preprocessing (SURVEY.md §2.2 "Env wrappers")
+    frame_skip: int = 4
+    frame_stack: int = 4
+    resize: int = 84
+    grayscale: bool = True
+    max_noop_start: int = 30
+    episodic_life: bool = True
+    clip_rewards: bool = True
+    max_episode_frames: int = 108_000  # 30 min @ 60Hz, standard ALE cap
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    kind: str = "mlp"  # mlp | nature_cnn | lstm_q | dpg
+    mlp_hidden: tuple[int, ...] = (256, 256)
+    cnn_channels: tuple[int, ...] = (32, 64, 64)
+    cnn_kernels: tuple[int, ...] = (8, 4, 3)
+    cnn_strides: tuple[int, ...] = (4, 2, 1)
+    torso_dense: int = 512
+    dueling: bool = True
+    lstm_size: int = 512
+    # DPG (continuous control)
+    dpg_hidden: tuple[int, ...] = (300, 200)
+    # Compute dtype for the forward/backward pass (params stay f32).
+    compute_dtype: str = "bfloat16"
+
+
+@dataclass(frozen=True)
+class ReplayConfig:
+    kind: str = "prioritized"  # uniform | prioritized | sequence
+    capacity: int = 2_000_000
+    alpha: float = 0.6
+    beta: float = 0.4
+    eps: float = 1e-6  # priority floor
+    # R2D2 sequence replay (SURVEY.md §3.4)
+    seq_length: int = 80
+    seq_overlap: int = 40
+    burn_in: int = 40
+    # priority = eta*max|td| + (1-eta)*mean|td| over the sequence
+    priority_eta: float = 0.9
+    min_fill: int = 50_000  # transitions before learning starts
+
+
+@dataclass(frozen=True)
+class LearnerConfig:
+    batch_size: int = 512
+    lr: float = 2.5e-4 / 4
+    adam_eps: float = 1.5e-7
+    gamma: float = 0.99
+    n_step: int = 3
+    target_sync_every: int = 2500
+    max_grad_norm: float = 40.0
+    huber_delta: float = 1.0
+    double_dqn: bool = True
+    value_rescale: bool = False  # R2D2 h(x) transform
+    publish_every: int = 50  # learner→actor weight publish cadence (steps)
+    # DPG
+    critic_lr: float = 1e-3
+    policy_lr: float = 1e-4
+    tau: float = 0.005  # soft target update for DPG
+
+
+@dataclass(frozen=True)
+class ActorConfig:
+    num_actors: int = 8
+    # eps_i = base_eps ** (1 + alpha * i / (N-1))  (Horgan et al. 2018)
+    base_eps: float = 0.4
+    eps_alpha: float = 7.0
+    ingest_batch: int = 50  # transitions buffered before shipping
+    param_pull_every: int = 400  # env steps between parameter pulls
+    # continuous-control exploration noise stddev (DPG)
+    noise_sigma: float = 0.2
+
+
+@dataclass(frozen=True)
+class InferenceConfig:
+    max_batch: int = 64
+    deadline_ms: float = 2.0  # dynamic batching deadline
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    dp: int = 1  # data-parallel (ICI) learner shards
+    tp: int = 1  # tensor-parallel shards for dense layers
+
+
+@dataclass(frozen=True)
+class RunConfig:
+    name: str = "cartpole_smoke"
+    seed: int = 0
+    total_env_frames: int = 200_000
+    env: EnvConfig = field(default_factory=EnvConfig)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    replay: ReplayConfig = field(default_factory=ReplayConfig)
+    learner: LearnerConfig = field(default_factory=LearnerConfig)
+    actors: ActorConfig = field(default_factory=ActorConfig)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    eval_every_steps: int = 10_000
+    eval_episodes: int = 10
+    eval_eps: float = 0.001
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 50_000
+
+    def replace(self, **kw: Any) -> "RunConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def _preset_cartpole_smoke() -> RunConfig:
+    """Config 1: CartPole-v1 single-actor DQN, MLP, uniform replay (CPU smoke)."""
+    return RunConfig(
+        name="cartpole_smoke",
+        total_env_frames=120_000,
+        env=EnvConfig(id="CartPole-v1", kind="cartpole"),
+        network=NetworkConfig(kind="mlp", mlp_hidden=(256, 256), dueling=False,
+                              compute_dtype="float32"),
+        replay=ReplayConfig(kind="uniform", capacity=50_000, min_fill=1_000),
+        learner=LearnerConfig(batch_size=64, lr=1e-3, n_step=1,
+                              target_sync_every=500),
+        actors=ActorConfig(num_actors=1, base_eps=1.0),
+    )
+
+
+def _preset_pong() -> RunConfig:
+    """Config 2: PongNoFrameskip-v4, Nature-CNN, 8 actors, prioritized replay."""
+    return RunConfig(
+        name="pong",
+        total_env_frames=10_000_000,
+        env=EnvConfig(id="PongNoFrameskip-v4", kind="atari"),
+        network=NetworkConfig(kind="nature_cnn", dueling=True),
+        replay=ReplayConfig(kind="prioritized", capacity=1_000_000,
+                            min_fill=20_000),
+        learner=LearnerConfig(batch_size=512),
+        actors=ActorConfig(num_actors=8),
+    )
+
+
+def _preset_atari57_apex() -> RunConfig:
+    """Config 3: full Ape-X over the 57-game ALE suite, 256 actors."""
+    return RunConfig(
+        name="atari57_apex",
+        total_env_frames=22_500_000_000,
+        env=EnvConfig(id="atari57", kind="atari"),
+        network=NetworkConfig(kind="nature_cnn", dueling=True),
+        replay=ReplayConfig(kind="prioritized", capacity=2_000_000),
+        learner=LearnerConfig(batch_size=512),
+        actors=ActorConfig(num_actors=256),
+        parallel=ParallelConfig(dp=4, tp=2),
+    )
+
+
+def _preset_r2d2() -> RunConfig:
+    """Config 4: recurrent LSTM Q-net with stored-state sequence replay."""
+    return RunConfig(
+        name="r2d2",
+        total_env_frames=10_000_000_000,
+        env=EnvConfig(id="atari57", kind="atari"),
+        network=NetworkConfig(kind="lstm_q", dueling=True),
+        replay=ReplayConfig(kind="sequence", capacity=100_000,  # sequences
+                            seq_length=80, seq_overlap=40, burn_in=40,
+                            min_fill=5_000),
+        learner=LearnerConfig(batch_size=64, n_step=5, value_rescale=True,
+                              target_sync_every=2500, lr=1e-4),
+        actors=ActorConfig(num_actors=256),
+        parallel=ParallelConfig(dp=4, tp=2),
+    )
+
+
+def _preset_apex_dpg() -> RunConfig:
+    """Config 5: Ape-X DPG continuous control (DM Control humanoid class)."""
+    return RunConfig(
+        name="apex_dpg",
+        total_env_frames=100_000_000,
+        env=EnvConfig(id="humanoid_stand", kind="control"),
+        network=NetworkConfig(kind="dpg", compute_dtype="float32"),
+        replay=ReplayConfig(kind="prioritized", capacity=1_000_000,
+                            min_fill=10_000),
+        learner=LearnerConfig(batch_size=256, n_step=5, gamma=0.99),
+        actors=ActorConfig(num_actors=32),
+    )
+
+
+PRESETS = {
+    "cartpole_smoke": _preset_cartpole_smoke,
+    "pong": _preset_pong,
+    "atari57_apex": _preset_atari57_apex,
+    "r2d2": _preset_r2d2,
+    "apex_dpg": _preset_apex_dpg,
+}
+
+
+def get_config(name: str, **overrides: Any) -> RunConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown config {name!r}; known: {sorted(PRESETS)}")
+    cfg = PRESETS[name]()
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    return cfg

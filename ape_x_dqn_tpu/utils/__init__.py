@@ -1,0 +1,2 @@
+from ape_x_dqn_tpu.utils.rng import RngStream, split_key
+from ape_x_dqn_tpu.utils.metrics import Metrics, Throughput

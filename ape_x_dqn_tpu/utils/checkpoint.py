@@ -1,0 +1,45 @@
+"""Checkpoint / resume (SURVEY.md §5).
+
+Saves params, target params, optimizer state, learner step, and actor
+epsilon-schedule state via Orbax; replay contents are optionally included
+(large — off by default). Resume must reproduce metric continuity, which
+``tests/test_checkpoint.py`` asserts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._mngr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def restore(self, step: int | None = None, template: Any = None) -> Any:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        if template is not None:
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(template))
+        return self._mngr.restore(step)
+
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()

@@ -1,0 +1,42 @@
+"""Deterministic RNG threading.
+
+Every component (actor i, learner, replay, eval) derives its keys from the
+run seed by folding in a stable component tag, so runs are reproducible
+regardless of process/thread scheduling (SURVEY.md §4 determinism tests).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+
+
+def component_key(seed: int, component: str, index: int = 0) -> jax.Array:
+    """Stable per-component PRNG key: fold a string tag + index into seed."""
+    tag = zlib.crc32(component.encode()) & 0x7FFFFFFF
+    key = jax.random.key(seed)
+    key = jax.random.fold_in(key, tag)
+    return jax.random.fold_in(key, index)
+
+
+def split_key(key: jax.Array, n: int = 2):
+    return jax.random.split(key, n)
+
+
+class RngStream:
+    """Host-side stateful stream of keys (for actor loops, not for jit)."""
+
+    def __init__(self, seed: int, component: str, index: int = 0):
+        self._key = component_key(seed, component, index)
+        self._count = 0
+
+    def next(self) -> jax.Array:
+        self._count += 1
+        return jax.random.fold_in(self._key, self._count)
+
+    def next_uint32(self) -> int:
+        """A host-side uint32 draw (for numpy envs / python-side decisions)."""
+        k = self.next()
+        return int(jax.random.bits(k, shape=(), dtype=jnp.uint32))

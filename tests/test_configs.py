@@ -1,0 +1,74 @@
+import jax
+import pytest
+
+from ape_x_dqn_tpu.configs import PRESETS, get_config
+from ape_x_dqn_tpu.utils.rng import RngStream, component_key
+from ape_x_dqn_tpu.utils.metrics import (
+    Metrics, Throughput, human_normalized_score, median_hns,
+    ATARI_HUMAN_RANDOM)
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_five_presets_exist():
+    # The five attested reference configs (SURVEY.md §2.1).
+    assert set(PRESETS) == {
+        "cartpole_smoke", "pong", "atari57_apex", "r2d2", "apex_dpg"}
+
+
+def test_preset_fields():
+    cp = get_config("cartpole_smoke")
+    assert cp.replay.kind == "uniform" and cp.actors.num_actors == 1
+    pong = get_config("pong")
+    assert pong.replay.kind == "prioritized" and pong.actors.num_actors == 8
+    apex = get_config("atari57_apex")
+    assert apex.actors.num_actors == 256
+    assert apex.network.dueling and apex.learner.double_dqn
+    r2d2 = get_config("r2d2")
+    assert r2d2.replay.kind == "sequence"
+    assert r2d2.replay.seq_length == 80 and r2d2.replay.burn_in == 40
+    dpg = get_config("apex_dpg")
+    assert dpg.network.kind == "dpg"
+
+
+def test_config_override():
+    cfg = get_config("pong", seed=7)
+    assert cfg.seed == 7
+    cfg2 = cfg.replace(total_env_frames=123)
+    assert cfg2.total_env_frames == 123 and cfg.total_env_frames != 123
+
+
+def test_unknown_config():
+    with pytest.raises(KeyError):
+        get_config("nope")
+
+
+def test_rng_determinism():
+    a = RngStream(0, "actor", 3)
+    b = RngStream(0, "actor", 3)
+    assert a.next_uint32() == b.next_uint32()
+    c = RngStream(0, "actor", 4)
+    assert a.next_uint32() != c.next_uint32()  # different actor index
+    k1 = component_key(0, "learner")
+    k2 = component_key(0, "replay")
+    assert (jax.random.bits(k1, (), "uint32")
+            != jax.random.bits(k2, (), "uint32"))
+
+
+def test_metrics_and_throughput(tmp_path):
+    m = Metrics(str(tmp_path / "log.jsonl"))
+    m.log(1, loss=0.5, frames=100)
+    assert m.latest()["loss"] == 0.5
+    m.close()
+    t = Throughput(window_s=100.0)
+    t.add(10, now=0.0)
+    t.add(10, now=1.0)
+    assert abs(t.rate(now=1.0) - 20.0) < 1e-6
+
+
+def test_hns():
+    assert len(ATARI_HUMAN_RANDOM) == 57
+    assert abs(human_normalized_score("pong", 14.6) - 1.0) < 1e-9
+    assert abs(median_hns({"pong": 14.6, "breakout": 30.5}) - 1.0) < 1e-9
