@@ -25,7 +25,6 @@ class EnvConfig:
     frame_skip: int = 4
     frame_stack: int = 4
     resize: int = 84
-    grayscale: bool = True
     max_noop_start: int = 30
     episodic_life: bool = True
     clip_rewards: bool = True

@@ -1,0 +1,2 @@
+from ape_x_dqn_tpu.envs.base import Env, EnvSpec, make_env
+from ape_x_dqn_tpu.envs.vector import SyncVectorEnv

@@ -1,0 +1,44 @@
+"""Network factory + model helpers."""
+
+from ape_x_dqn_tpu.models.base import (
+    hard_update, init_params, param_count, preprocess_obs, soft_update)
+from ape_x_dqn_tpu.models.qnets import MLPQNet, NatureDQN, DuelingHead
+from ape_x_dqn_tpu.models.lstm_q import ApeXLSTMQNet, LSTMState
+from ape_x_dqn_tpu.models.dpg import DPGActor, DPGCritic
+
+
+def build_network(net_cfg, spec):
+    """Build the module matching a NetworkConfig for an EnvSpec.
+
+    For kind='dpg' returns (actor, critic); otherwise a single Q-network.
+    """
+    if net_cfg.kind == "mlp":
+        return MLPQNet(num_actions=spec.num_actions,
+                       hidden=tuple(net_cfg.mlp_hidden),
+                       dueling=net_cfg.dueling,
+                       compute_dtype=net_cfg.compute_dtype)
+    if net_cfg.kind == "nature_cnn":
+        return NatureDQN(num_actions=spec.num_actions,
+                         channels=tuple(net_cfg.cnn_channels),
+                         kernels=tuple(net_cfg.cnn_kernels),
+                         strides=tuple(net_cfg.cnn_strides),
+                         dense=net_cfg.torso_dense,
+                         dueling=net_cfg.dueling,
+                         compute_dtype=net_cfg.compute_dtype)
+    if net_cfg.kind == "lstm_q":
+        return ApeXLSTMQNet(num_actions=spec.num_actions,
+                            lstm_size=net_cfg.lstm_size,
+                            dense=net_cfg.torso_dense,
+                            dueling=net_cfg.dueling,
+                            compute_dtype=net_cfg.compute_dtype,
+                            mlp_torso=len(spec.obs_shape) == 1)
+    if net_cfg.kind == "dpg":
+        actor = DPGActor(action_dim=spec.action_dim,
+                         action_low=spec.action_low,
+                         action_high=spec.action_high,
+                         hidden=tuple(net_cfg.dpg_hidden),
+                         compute_dtype=net_cfg.compute_dtype)
+        critic = DPGCritic(hidden=tuple(net_cfg.dpg_hidden),
+                           compute_dtype=net_cfg.compute_dtype)
+        return actor, critic
+    raise ValueError(f"unknown network kind {net_cfg.kind!r}")

@@ -1,0 +1,54 @@
+"""Model-layer helpers.
+
+Networks are flax.linen modules held as pure functions + param pytrees.
+Params live in float32; the forward/backward compute dtype is bfloat16 by
+default (NetworkConfig.compute_dtype) so matmuls/convs hit the MXU at
+full rate, with Q-value outputs cast back to float32 for the loss.
+
+Reference parity: SURVEY.md §2.2 rows "MLP Q-net", "Nature-CNN",
+"Dueling heads", "LSTM Q-net", "DPG actor-critic".
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def dtype_of(name: str):
+    return {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+            "float16": jnp.float16}[name]
+
+
+def preprocess_obs(obs: jax.Array, compute_dtype) -> jax.Array:
+    """uint8 image obs -> scaled float in compute dtype; float obs -> cast.
+
+    Scaling to [0,1] happens on-device so replay stores uint8 (4x HBM
+    saving + 4x ingest bandwidth saving vs float32 frames).
+    """
+    if obs.dtype == jnp.uint8:
+        return obs.astype(compute_dtype) / jnp.asarray(255.0, compute_dtype)
+    return obs.astype(compute_dtype)
+
+
+def init_params(module, rng: jax.Array, sample_obs: jax.Array,
+                **extra) -> Any:
+    return module.init(rng, sample_obs, **extra)
+
+
+def hard_update(target_params: Any, online_params: Any) -> Any:
+    """Target-network hard sync (every K learner steps)."""
+    del target_params
+    return jax.tree.map(lambda p: p, online_params)
+
+
+def soft_update(target_params: Any, online_params: Any, tau: float) -> Any:
+    """Polyak averaging for DPG target nets."""
+    return jax.tree.map(lambda t, p: (1.0 - tau) * t + tau * p,
+                        target_params, online_params)
+
+
+def param_count(params: Any) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,51 @@
+"""Deterministic-policy-gradient actor-critic for continuous control.
+
+Ape-X DPG (Horgan et al. 2018 §"Ape-X DPG"; SURVEY.md §2.2 "DPG
+actor-critic"): a deterministic policy network mu(s) with a tanh-squashed
+bounded output, and a Q(s, a) critic; both have target copies updated by
+Polyak averaging (models.base.soft_update).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ape_x_dqn_tpu.models.base import dtype_of, preprocess_obs
+
+
+class DPGActor(nn.Module):
+    action_dim: int
+    action_low: float = -1.0
+    action_high: float = 1.0
+    hidden: Sequence[int] = (300, 200)
+    compute_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        dt = dtype_of(self.compute_dtype)
+        x = preprocess_obs(obs, dt)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=dt)(x))
+        a = jnp.tanh(nn.Dense(self.action_dim, dtype=dt)(x))
+        mid = (self.action_high + self.action_low) / 2.0
+        half = (self.action_high - self.action_low) / 2.0
+        return (mid + half * a).astype(jnp.float32)
+
+
+class DPGCritic(nn.Module):
+    hidden: Sequence[int] = (300, 200)
+    compute_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        dt = dtype_of(self.compute_dtype)
+        x = jnp.concatenate(
+            [preprocess_obs(obs, dt), action.astype(dt)], axis=-1)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=dt)(x))
+        q = nn.Dense(1, dtype=dt)(x)
+        return jnp.squeeze(q, -1).astype(jnp.float32)

@@ -1,0 +1,93 @@
+"""Q-networks: MLP (CartPole), Nature-CNN with dueling heads (Atari).
+
+TPU-first choices:
+- NHWC conv layout (XLA's native TPU layout) with uint8 obs dequantized
+  on-device (models.base.preprocess_obs).
+- bfloat16 compute / float32 params; Q outputs in float32.
+- Dueling merge Q = V + A - mean(A) (Wang et al. 2016), as attested for
+  the reference (SURVEY.md §2.2 "Dueling heads").
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ape_x_dqn_tpu.models.base import dtype_of, preprocess_obs
+
+
+class DuelingHead(nn.Module):
+    num_actions: int
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        v = nn.Dense(1, dtype=self.dtype, name="value")(x)
+        a = nn.Dense(self.num_actions, dtype=self.dtype, name="advantage")(x)
+        q = v + a - jnp.mean(a, axis=-1, keepdims=True)
+        return q.astype(jnp.float32)
+
+
+class MLPQNet(nn.Module):
+    """Dense Q-network for low-dimensional observations (config 1)."""
+
+    num_actions: int
+    hidden: Sequence[int] = (256, 256)
+    dueling: bool = False
+    compute_dtype: str = "float32"
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        dt = dtype_of(self.compute_dtype)
+        x = preprocess_obs(obs, dt)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h, dtype=dt)(x))
+        if self.dueling:
+            return DuelingHead(self.num_actions, dtype=dt)(x)
+        return nn.Dense(self.num_actions, dtype=dt)(x).astype(jnp.float32)
+
+
+class NatureCNNTorso(nn.Module):
+    """The classic DQN conv stack (Mnih et al. 2015): 32x8s4, 64x4s2,
+    64x3s1, dense 512 — attested for the reference (SURVEY.md §2.2)."""
+
+    channels: Sequence[int] = (32, 64, 64)
+    kernels: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    dense: int = 512
+    dtype: object = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for ch, k, s in zip(self.channels, self.kernels, self.strides):
+            x = nn.Conv(ch, (k, k), strides=(s, s), padding="VALID",
+                        dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = x.reshape((*x.shape[:-3], -1))
+        x = nn.relu(nn.Dense(self.dense, dtype=self.dtype, name="torso_out")(x))
+        return x
+
+
+class NatureDQN(nn.Module):
+    """Nature-CNN torso + (dueling) Q head over uint8 NHWC frames."""
+
+    num_actions: int
+    channels: Sequence[int] = (32, 64, 64)
+    kernels: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    dense: int = 512
+    dueling: bool = True
+    compute_dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        dt = dtype_of(self.compute_dtype)
+        x = preprocess_obs(obs, dt)
+        x = NatureCNNTorso(self.channels, self.kernels, self.strides,
+                           self.dense, dtype=dt, name="torso")(x)
+        if self.dueling:
+            return DuelingHead(self.num_actions, dtype=dt)(x)
+        return nn.Dense(self.num_actions, dtype=dt)(x).astype(jnp.float32)

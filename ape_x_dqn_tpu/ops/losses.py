@@ -1,0 +1,226 @@
+"""Training losses, each designed to live inside a single learner jit.
+
+Reference parity (SURVEY.md §3.3–§3.4, §2.2 "Double-DQN Huber loss"):
+- n-step double-DQN Huber loss with importance-sampling weights — the
+  reference's fused CUDA training step becomes one XLA graph here.
+- R2D2 sequence loss: stored-state unroll, burn-in with a stop-gradient
+  on the recurrent state, n-step targets inside the sequence, value
+  rescaling, and the eta-mix max/mean sequence priority.
+- Ape-X DPG critic/policy losses with Polyak targets.
+
+All losses return (scalar_loss, aux) where aux carries the |TD| priorities
+the learner writes back into the sum-tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ape_x_dqn_tpu.ops import value_rescale
+
+
+def huber(x: jax.Array, delta: float = 1.0) -> jax.Array:
+    abs_x = jnp.abs(x)
+    quad = jnp.minimum(abs_x, delta)
+    return 0.5 * quad**2 + delta * (abs_x - quad)
+
+
+class TransitionBatch(NamedTuple):
+    """A batch of n-step transitions (time-collapsed, SURVEY.md §3.3).
+
+    rewards are the already-accumulated n-step discounted returns R_n;
+    discounts are gamma^n * (1 - terminal) for the bootstrap term.
+    """
+
+    obs: jax.Array        # [B, ...]
+    actions: jax.Array    # [B] int32
+    rewards: jax.Array    # [B] f32   (n-step return)
+    next_obs: jax.Array   # [B, ...]  (s_{t+n})
+    discounts: jax.Array  # [B] f32   (gamma^n, 0 at terminal)
+
+
+def dqn_td_error(q_s: jax.Array, q_sp_online: jax.Array,
+                 q_sp_target: jax.Array, batch: TransitionBatch,
+                 double: bool = True,
+                 rescale: bool = False) -> jax.Array:
+    """Per-sample TD error for the (double) n-step DQN target."""
+    q_sa = jnp.take_along_axis(
+        q_s, batch.actions[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    if double:
+        a_star = jnp.argmax(q_sp_online, axis=-1)
+        q_boot = jnp.take_along_axis(
+            q_sp_target, a_star[:, None], axis=-1)[:, 0]
+    else:
+        q_boot = jnp.max(q_sp_target, axis=-1)
+    if rescale:
+        target = value_rescale.h(
+            batch.rewards + batch.discounts * value_rescale.h_inv(q_boot))
+    else:
+        target = batch.rewards + batch.discounts * q_boot
+    return q_sa - jax.lax.stop_gradient(target)
+
+
+def make_dqn_loss(net_apply: Callable, double: bool = True,
+                  huber_delta: float = 1.0, rescale: bool = False):
+    """Build loss(params, target_params, batch, is_weights) -> (loss, aux)."""
+
+    def loss_fn(params: Any, target_params: Any, batch: TransitionBatch,
+                is_weights: jax.Array):
+        q_s = net_apply(params, batch.obs)
+        q_sp_online = net_apply(params, batch.next_obs)
+        q_sp_target = net_apply(target_params, batch.next_obs)
+        td = dqn_td_error(q_s, q_sp_online, q_sp_target, batch,
+                          double=double, rescale=rescale)
+        per_sample = huber(td, huber_delta)
+        loss = jnp.mean(is_weights * per_sample)
+        aux = {"td_abs": jnp.abs(td), "loss_per_sample": per_sample,
+               "q_mean": q_s.mean()}
+        return loss, aux
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# R2D2 sequence loss
+
+
+class SequenceBatch(NamedTuple):
+    """Fixed-length sequences with stored recurrent state (SURVEY.md §3.4)."""
+
+    obs: jax.Array        # [B, L, ...]
+    actions: jax.Array    # [B, L] int32
+    rewards: jax.Array    # [B, L] f32 (per-step, undiscounted)
+    terminals: jax.Array  # [B, L] f32 (1 at true terminal steps)
+    mask: jax.Array       # [B, L] f32 (1 on valid steps; 0 on padding)
+    init_state: tuple     # (c, h) each [B, H] — state before obs[:, 0]
+
+
+def nstep_targets_in_sequence(rewards: jax.Array, terminals: jax.Array,
+                              bootstrap: jax.Array, mask: jax.Array,
+                              n_step: int, gamma: float,
+                              rescale: bool) -> tuple[jax.Array, jax.Array]:
+    """n-step targets at every t using values bootstrap[t+n] within [0, L).
+
+    bootstrap[t] is the (already action-selected) bootstrap value estimate
+    at time t in the *rescaled* space if rescale else raw. Positions whose
+    t+n falls off the sequence end are reported invalid via the returned
+    validity mask.
+    """
+    b, length = rewards.shape
+    if rescale:
+        bootstrap = value_rescale.h_inv(bootstrap)
+    ret = jnp.zeros((b, length))
+    disc = jnp.ones((b, length))
+    alive = jnp.ones((b, length))
+    # static unroll over n (n is 3-5): R_n[t] = sum_k gamma^k r[t+k] * alive
+    for k in range(n_step):
+        r_k = jnp.roll(rewards, -k, axis=1)
+        ret = ret + disc * alive * r_k
+        d_k = jnp.roll(terminals, -k, axis=1)
+        alive = alive * (1.0 - d_k)
+        disc = disc * gamma
+    boot_n = jnp.roll(bootstrap, -n_step, axis=1)
+    target = ret + disc * alive * boot_n
+    if rescale:
+        target = value_rescale.h(target)
+    # valid iff t + n_step < L, the step itself is real data, AND the
+    # bootstrap position is real data (never bootstrap from padding)
+    t_idx = jnp.arange(length)[None, :]
+    mask_boot = jnp.roll(mask, -n_step, axis=1)
+    valid = (t_idx < length - n_step).astype(jnp.float32) * mask * mask_boot
+    return target, valid
+
+
+def make_r2d2_loss(net_apply_seq: Callable, burn_in: int, n_step: int,
+                   gamma: float, huber_delta: float = 1.0,
+                   double: bool = True, rescale: bool = True,
+                   priority_eta: float = 0.9):
+    """Build the R2D2 sequence loss.
+
+    net_apply_seq(params, obs[B,T,...], state) -> (q[B,T,A], final_state)
+    """
+
+    def loss_fn(params: Any, target_params: Any, batch: SequenceBatch,
+                is_weights: jax.Array):
+        state0 = tuple(batch.init_state)
+        if burn_in > 0:
+            _, state_b = net_apply_seq(params, batch.obs[:, :burn_in],
+                                       state0)
+            state_b = jax.tree.map(jax.lax.stop_gradient, state_b)
+            _, state_bt = net_apply_seq(target_params,
+                                        batch.obs[:, :burn_in], state0)
+        else:
+            state_b = state0
+            state_bt = state0
+        obs_t = batch.obs[:, burn_in:]
+        q_online, _ = net_apply_seq(params, obs_t, state_b)  # [B, T, A]
+        q_target, _ = net_apply_seq(target_params, obs_t, state_bt)
+
+        actions = batch.actions[:, burn_in:]
+        rewards = batch.rewards[:, burn_in:]
+        terminals = batch.terminals[:, burn_in:]
+        mask = batch.mask[:, burn_in:]
+
+        q_sa = jnp.take_along_axis(
+            q_online, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        if double:
+            a_star = jnp.argmax(q_online, axis=-1)
+            boot = jnp.take_along_axis(
+                q_target, a_star[..., None], axis=-1)[..., 0]
+        else:
+            boot = jnp.max(q_target, axis=-1)
+        target, valid = nstep_targets_in_sequence(
+            rewards, terminals, boot, mask, n_step, gamma, rescale)
+        td = (q_sa - jax.lax.stop_gradient(target)) * valid
+        per_step = huber(td, huber_delta)
+        denom = jnp.maximum(valid.sum(axis=1), 1.0)
+        per_seq = per_step.sum(axis=1) / denom
+        loss = jnp.mean(is_weights * per_seq)
+
+        td_abs = jnp.abs(td)
+        max_td = td_abs.max(axis=1)
+        mean_td = td_abs.sum(axis=1) / denom
+        priorities = priority_eta * max_td + (1 - priority_eta) * mean_td
+        aux = {"td_abs": priorities, "q_mean": q_sa.mean(),
+               "valid_frac": valid.mean()}
+        return loss, aux
+
+    return loss_fn
+
+
+# ---------------------------------------------------------------------------
+# Ape-X DPG losses
+
+
+class ContinuousBatch(NamedTuple):
+    obs: jax.Array        # [B, D]
+    actions: jax.Array    # [B, A] f32
+    rewards: jax.Array    # [B] f32 (n-step return)
+    next_obs: jax.Array   # [B, D]
+    discounts: jax.Array  # [B] f32
+
+
+def make_dpg_losses(actor_apply: Callable, critic_apply: Callable):
+    """Build (critic_loss, policy_loss) closures for Ape-X DPG."""
+
+    def critic_loss(critic_params: Any, target_critic: Any,
+                    target_actor: Any, batch: ContinuousBatch,
+                    is_weights: jax.Array):
+        a_next = actor_apply(target_actor, batch.next_obs)
+        q_next = critic_apply(target_critic, batch.next_obs, a_next)
+        target = batch.rewards + batch.discounts * q_next
+        q = critic_apply(critic_params, batch.obs, batch.actions)
+        td = q - jax.lax.stop_gradient(target)
+        loss = jnp.mean(is_weights * 0.5 * td**2)
+        return loss, {"td_abs": jnp.abs(td), "q_mean": q.mean()}
+
+    def policy_loss(actor_params: Any, critic_params: Any,
+                    batch: ContinuousBatch):
+        a = actor_apply(actor_params, batch.obs)
+        q = critic_apply(critic_params, batch.obs, a)
+        return -jnp.mean(q), {"a_abs_mean": jnp.abs(a).mean()}
+
+    return critic_loss, policy_loss

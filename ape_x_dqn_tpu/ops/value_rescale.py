@@ -1,0 +1,22 @@
+"""R2D2 value-function rescaling (Kapturowski et al. 2019; SURVEY.md §3.4).
+
+h(x) = sign(x) * (sqrt(|x| + 1) - 1) + eps * x
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-3
+
+
+def h(x: jax.Array, eps: float = EPS) -> jax.Array:
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def h_inv(x: jax.Array, eps: float = EPS) -> jax.Array:
+    """Exact closed-form inverse of h."""
+    return jnp.sign(x) * (
+        ((jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0)
+         / (2.0 * eps)) ** 2 - 1.0)

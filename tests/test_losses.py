@@ -1,0 +1,214 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.ops import value_rescale
+from ape_x_dqn_tpu.ops.losses import (
+    ContinuousBatch, SequenceBatch, TransitionBatch, dqn_td_error, huber,
+    make_dqn_loss, make_dpg_losses, make_r2d2_loss,
+    nstep_targets_in_sequence)
+from ape_x_dqn_tpu.ops.nstep import NStepBuilder
+
+
+def test_huber_values():
+    x = jnp.array([0.5, 1.0, 2.0, -3.0])
+    expected = jnp.array([0.125, 0.5, 1.5, 2.5])  # delta=1
+    np.testing.assert_allclose(huber(x), expected, rtol=1e-6)
+
+
+def test_value_rescale_inverse():
+    x = jnp.linspace(-50.0, 50.0, 101)
+    np.testing.assert_allclose(value_rescale.h_inv(value_rescale.h(x)), x,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_dqn_td_error_hand_computed():
+    """Tiny hand-worked example (SURVEY.md §4 'loss value against a tiny
+    hand-computed example')."""
+    q_s = jnp.array([[1.0, 2.0]])          # Q(s,.), action taken = 0 -> 1.0
+    q_sp_online = jnp.array([[0.5, 3.0]])  # argmax -> action 1
+    q_sp_target = jnp.array([[10.0, 4.0]])  # double-DQN evaluates -> 4.0
+    batch = TransitionBatch(
+        obs=None, actions=jnp.array([0]), rewards=jnp.array([1.5]),
+        next_obs=None, discounts=jnp.array([0.9]))
+    td = dqn_td_error(q_s, q_sp_online, q_sp_target, batch, double=True)
+    # target = 1.5 + 0.9 * 4.0 = 5.1; td = 1.0 - 5.1 = -4.1
+    np.testing.assert_allclose(td, [-4.1], rtol=1e-6)
+    td_plain = dqn_td_error(q_s, q_sp_online, q_sp_target, batch,
+                            double=False)
+    # plain DQN: max target Q = 10.0 -> target 10.5; td = -9.5
+    np.testing.assert_allclose(td_plain, [-9.5], rtol=1e-6)
+
+
+def test_dqn_loss_is_weighting():
+    def net_apply(params, obs):
+        return obs @ params  # linear "net": obs [B,2] @ [2,2]
+
+    params = jnp.eye(2)
+    target_params = jnp.eye(2)
+    loss_fn = make_dqn_loss(net_apply, double=True)
+    batch = TransitionBatch(
+        obs=jnp.array([[1.0, 0.0], [0.0, 1.0]]),
+        actions=jnp.array([0, 1]),
+        rewards=jnp.array([0.0, 0.0]),
+        next_obs=jnp.zeros((2, 2)),
+        discounts=jnp.array([0.0, 0.0]))
+    # q_sa = [1, 1], target = 0 -> td = 1 -> huber = 0.5 each
+    loss_eq, aux = loss_fn(params, target_params, batch, jnp.array([1.0, 1.0]))
+    np.testing.assert_allclose(loss_eq, 0.5, rtol=1e-6)
+    np.testing.assert_allclose(aux["td_abs"], [1.0, 1.0], rtol=1e-6)
+    # doubling one IS weight scales its contribution
+    loss_w, _ = loss_fn(params, target_params, batch, jnp.array([2.0, 0.0]))
+    np.testing.assert_allclose(loss_w, 0.5, rtol=1e-6)  # (2*0.5 + 0)/2
+
+
+def test_dqn_loss_grad_flows():
+    def net_apply(params, obs):
+        return obs @ params
+
+    loss_fn = make_dqn_loss(net_apply)
+    batch = TransitionBatch(
+        obs=jnp.array([[1.0, 2.0]]), actions=jnp.array([0]),
+        rewards=jnp.array([1.0]), next_obs=jnp.array([[0.5, 0.5]]),
+        discounts=jnp.array([0.9]))
+    g = jax.grad(lambda p: loss_fn(p, jnp.eye(2), batch,
+                                   jnp.ones(1))[0])(jnp.eye(2))
+    assert jnp.abs(g).sum() > 0  # online net receives gradient
+    # target params get no gradient (stop_gradient on target)
+    g_t = jax.grad(lambda tp: loss_fn(jnp.eye(2), tp, batch,
+                                      jnp.ones(1))[0])(jnp.eye(2))
+    np.testing.assert_allclose(g_t, 0.0)
+
+
+def test_nstep_targets_in_sequence_hand_computed():
+    gamma = 0.5
+    rewards = jnp.array([[1.0, 2.0, 4.0, 8.0]])
+    terminals = jnp.zeros((1, 4))
+    boot = jnp.array([[10.0, 20.0, 30.0, 40.0]])
+    mask = jnp.ones((1, 4))
+    target, valid = nstep_targets_in_sequence(
+        rewards, terminals, boot, mask, n_step=2, gamma=gamma, rescale=False)
+    # t=0: 1 + 0.5*2 + 0.25*boot[2] = 2 + 7.5 = 9.5
+    # t=1: 2 + 0.5*4 + 0.25*boot[3] = 4 + 10 = 14
+    np.testing.assert_allclose(target[0, :2], [9.5, 14.0], rtol=1e-6)
+    np.testing.assert_allclose(valid[0], [1, 1, 0, 0])
+
+
+def test_nstep_targets_respect_terminals():
+    gamma = 0.9
+    rewards = jnp.array([[1.0, 5.0, 7.0]])
+    terminals = jnp.array([[1.0, 0.0, 0.0]])  # episode ends at t=0
+    boot = jnp.full((1, 3), 100.0)
+    mask = jnp.ones((1, 3))
+    target, valid = nstep_targets_in_sequence(
+        rewards, terminals, boot, mask, n_step=2, gamma=gamma, rescale=False)
+    # t=0: r0 = 1, then terminal: no r1, no bootstrap -> target = 1
+    np.testing.assert_allclose(target[0, 0], 1.0, rtol=1e-6)
+
+
+def test_r2d2_loss_runs_and_masks():
+    # trivial "net": q[t] = params * obs[t] summed, state passthrough
+    def net_apply_seq(params, obs, state):
+        q = jnp.einsum("btd,da->bta", obs, params)
+        return q, state
+
+    params = jnp.ones((3, 2))
+    loss_fn = make_r2d2_loss(net_apply_seq, burn_in=2, n_step=1, gamma=0.9,
+                             rescale=False)
+    b, length = 2, 6
+    batch = SequenceBatch(
+        obs=jax.random.normal(jax.random.key(0), (b, length, 3)),
+        actions=jnp.zeros((b, length), jnp.int32),
+        rewards=jnp.ones((b, length)),
+        terminals=jnp.zeros((b, length)),
+        mask=jnp.ones((b, length)),
+        init_state=(jnp.zeros((b, 4)), jnp.zeros((b, 4))))
+    loss, aux = loss_fn(params, params, batch, jnp.ones(b))
+    assert jnp.isfinite(loss)
+    assert aux["td_abs"].shape == (b,)  # per-sequence priorities
+    # gradient flows to params
+    g = jax.grad(lambda p: loss_fn(p, params, batch, jnp.ones(b))[0])(params)
+    assert jnp.abs(g).sum() > 0
+
+
+def test_dpg_losses():
+    def actor_apply(p, obs):
+        return jnp.tanh(obs @ p)
+
+    def critic_apply(p, obs, act):
+        return (obs @ p).sum(-1) + act.sum(-1)
+
+    critic_loss, policy_loss = make_dpg_losses(actor_apply, critic_apply)
+    batch = ContinuousBatch(
+        obs=jnp.array([[1.0, 0.0]]), actions=jnp.array([[0.3]]),
+        rewards=jnp.array([2.0]), next_obs=jnp.array([[0.0, 1.0]]),
+        discounts=jnp.array([0.9]))
+    p = jnp.ones((2, 1))
+    loss, aux = critic_loss(p, p, p, batch, jnp.ones(1))
+    assert jnp.isfinite(loss) and aux["td_abs"].shape == (1,)
+    pl, _ = policy_loss(p, p, batch)
+    g = jax.grad(lambda ap: policy_loss(ap, p, batch)[0])(p)
+    assert jnp.abs(g).sum() > 0
+
+
+def test_nstep_builder_hand_computed():
+    b = NStepBuilder(n_step=3, gamma=0.5)
+    obs = [np.array([float(i)]) for i in range(10)]
+    out = []
+    out += b.append(obs[0], 0, 1.0, obs[1], False)
+    out += b.append(obs[1], 1, 2.0, obs[2], False)
+    assert not out  # window not yet full
+    out += b.append(obs[2], 0, 4.0, obs[3], False)
+    assert len(out) == 1
+    t = out[0]
+    # R_3 = 1 + 0.5*2 + 0.25*4 = 3.0; discount = 0.5^3
+    assert t.reward == 3.0 and t.discount == 0.125
+    assert t.obs[0] == 0.0 and t.next_obs[0] == 3.0 and t.action == 0
+
+
+def test_nstep_builder_terminal_flush():
+    b = NStepBuilder(n_step=3, gamma=0.5)
+    obs = [np.array([float(i)]) for i in range(5)]
+    out = []
+    out += b.append(obs[0], 0, 1.0, obs[1], False)
+    out += b.append(obs[1], 0, 2.0, obs[2], True)  # terminal at step 2
+    # flush: two transitions, both with discount 0
+    assert len(out) == 2
+    assert out[0].reward == 1.0 + 0.5 * 2.0 and out[0].discount == 0.0
+    assert out[1].reward == 2.0 and out[1].discount == 0.0
+    assert len(b._window) == 0
+
+
+def test_nstep_builder_truncation_keeps_bootstrap():
+    b = NStepBuilder(n_step=3, gamma=0.5)
+    obs = [np.array([float(i)]) for i in range(5)]
+    out = b.append(obs[0], 0, 1.0, obs[1], False, truncated=True)
+    assert len(out) == 1
+    # truncated: bootstrap kept, discount = gamma^1
+    assert out[0].discount == 0.5
+
+
+def test_nstep_builder_terminal_on_window_full():
+    """Terminal arriving exactly when the window fills must zero the
+    bootstrap for ALL flushed transitions (regression: the full-window
+    emit used to bootstrap past the terminal)."""
+    b = NStepBuilder(n_step=3, gamma=0.5)
+    obs = [np.array([float(i)]) for i in range(5)]
+    out = []
+    out += b.append(obs[0], 0, 1.0, obs[1], False)
+    out += b.append(obs[1], 0, 2.0, obs[2], False)
+    out += b.append(obs[2], 0, 4.0, obs[3], True)  # terminal as window fills
+    assert len(out) == 3
+    assert all(t.discount == 0.0 for t in out)
+    assert out[0].reward == 1.0 + 0.5 * 2.0 + 0.25 * 4.0
+
+
+def test_sequence_targets_never_bootstrap_from_padding():
+    rewards = jnp.array([[1.0, 1.0, 1.0, 0.0]])
+    terminals = jnp.zeros((1, 4))
+    mask = jnp.array([[1.0, 1.0, 1.0, 0.0]])  # last step is padding
+    boot = jnp.full((1, 4), 100.0)
+    _, valid = nstep_targets_in_sequence(
+        rewards, terminals, boot, mask, n_step=1, gamma=0.9, rescale=False)
+    # t=2 would bootstrap from padded t=3 -> must be invalid
+    np.testing.assert_allclose(valid[0], [1.0, 1.0, 0.0, 0.0])
