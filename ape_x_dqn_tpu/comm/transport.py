@@ -1,0 +1,85 @@
+"""Transport layer: actor -> replay ingest and learner -> actor params.
+
+The reference moves experience and parameters over gRPC and does learner
+collectives over NCCL (SURVEY.md §2.2 "Comm"). The TPU-native mapping
+(SURVEY.md §5 "distributed communication backend"):
+
+- learner-internal collectives: XLA psum/all-gather over ICI (see
+  parallel/dist_learner.py) — nothing to do here.
+- learner -> inference-server weight publication: device-to-device
+  resharding over ICI (DistDQNLearner.publish_params).
+- actor <-> inference server and actor -> replay ingest: host-side
+  message passing. In-process that's thread-safe queues (the
+  `LoopbackTransport` below, also the deterministic test harness per
+  SURVEY.md §4); across hosts the same interface runs over TCP sockets
+  (`comm.socket_transport`) riding DCN.
+
+Messages are pytrees of numpy arrays; an ingest message is a dict with
+stacked transition fields plus "priorities".
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Protocol
+
+
+class Transport(Protocol):
+    def send_experience(self, batch: dict) -> None: ...
+    def recv_experience(self, timeout: float | None = None) -> dict | None: ...
+    def publish_params(self, params: Any, version: int) -> None: ...
+    def get_params(self) -> tuple[Any, int]: ...
+
+
+class LoopbackTransport:
+    """In-process transport: bounded queue + versioned param cell."""
+
+    def __init__(self, max_pending: int = 64):
+        self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
+        self._params: Any = None
+        self._version = -1
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    # experience path (actor -> replay ingest)
+
+    def send_experience(self, batch: dict) -> None:
+        """Non-blocking; drops oldest under backpressure (actors must
+        never stall the env loop — matches Ape-X semantics where replay
+        ingest is lossy-tolerant)."""
+        while True:
+            try:
+                self._q.put_nowait(batch)
+                return
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                    self._dropped += 1
+                except queue.Empty:
+                    pass
+
+    def recv_experience(self, timeout: float | None = None) -> dict | None:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    @property
+    def pending(self) -> int:
+        return self._q.qsize()
+
+    # parameter path (learner -> actors/server)
+
+    def publish_params(self, params: Any, version: int) -> None:
+        with self._lock:
+            self._params = params
+            self._version = version
+
+    def get_params(self) -> tuple[Any, int]:
+        with self._lock:
+            return self._params, self._version

@@ -137,8 +137,8 @@ def _preset_cartpole_smoke() -> RunConfig:
         network=NetworkConfig(kind="mlp", mlp_hidden=(256, 256), dueling=False,
                               compute_dtype="float32"),
         replay=ReplayConfig(kind="uniform", capacity=50_000, min_fill=1_000),
-        learner=LearnerConfig(batch_size=64, lr=1e-3, n_step=1,
-                              target_sync_every=500),
+        learner=LearnerConfig(batch_size=64, lr=1e-3, n_step=3,
+                              target_sync_every=250),
         actors=ActorConfig(num_actors=1, base_eps=1.0),
     )
 

@@ -249,9 +249,12 @@ class AtariPreprocessing(Env):
             frame = self._full_reset()
         else:
             # episodic-life pseudo-reset: continue the same raw episode
-            frame, _, done = self._raw.step(0)
+            frame, r, done = self._raw.step(0)
             self._elapsed += 1
-            if done:  # the noop itself ended the raw episode
+            self._ep_return += r  # keep eval/HNS scores exact
+            if done:
+                # the noop itself ended the raw episode; its return is
+                # dropped (matches standard EpisodicLife wrapper behavior)
                 frame = self._full_reset()
         self._lives = self._raw.lives
         return self._observe(frame)

@@ -18,14 +18,14 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from ape_x_dqn_tpu.ops import value_rescale
 
 
 def huber(x: jax.Array, delta: float = 1.0) -> jax.Array:
-    abs_x = jnp.abs(x)
-    quad = jnp.minimum(abs_x, delta)
-    return 0.5 * quad**2 + delta * (abs_x - quad)
+    """Huber of a residual (delegates to optax to keep one definition)."""
+    return optax.losses.huber_loss(x, jnp.zeros_like(x), delta=delta)
 
 
 class TransitionBatch(NamedTuple):

@@ -1,0 +1,93 @@
+"""Device-side sum-tree (segment tree) for prioritized replay.
+
+The reference keeps its sum-tree on the host (SURVEY.md §2.2 "Prioritized
+replay", §2.3 item 5); here it is a single `(2*capacity,)` float32 array
+in HBM, with batched updates and stratified sampling running *inside* the
+learner jit (BASELINE.json north_star: "the prioritized-replay sum-tree
+and importance-sampling weights live in HBM with device-side sampling").
+
+Layout: 1-indexed implicit binary tree. tree[1] is the root (total
+priority), leaves live at tree[capacity + i] for i in [0, capacity).
+Capacity must be a power of two so the descent depth is static.
+
+TPU-first design notes:
+- Updates recompute parents bottom-up: scatter leaf values, then per
+  level gather both children and scatter their sum. Recomputation (not
+  delta-accumulation) makes duplicate indices in one batch harmless,
+  so no host-side dedup is ever needed.
+- Sampling is a vectorized prefix-sum descent: log2(capacity) iterations
+  of a batched gather — no data-dependent control flow, fully unrolled
+  by XLA (static trip count).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def init(capacity: int) -> jax.Array:
+    assert capacity > 0 and (capacity & (capacity - 1)) == 0, \
+        "capacity must be a power of two"
+    return jnp.zeros(2 * capacity, jnp.float32)
+
+
+def capacity_of(tree: jax.Array) -> int:
+    return tree.shape[0] // 2
+
+
+def total(tree: jax.Array) -> jax.Array:
+    return tree[1]
+
+
+def leaves(tree: jax.Array) -> jax.Array:
+    return tree[capacity_of(tree):]
+
+
+def update(tree: jax.Array, leaf_idx: jax.Array,
+           priorities: jax.Array) -> jax.Array:
+    """Set priorities at leaf_idx ([B] int32) and repair ancestor sums."""
+    cap = capacity_of(tree)
+    depth = cap.bit_length() - 1  # log2(cap)
+    node = leaf_idx.astype(jnp.int32) + cap
+    tree = tree.at[node].set(priorities.astype(jnp.float32))
+    for _ in range(depth):
+        node = node >> 1
+        child_sum = tree[2 * node] + tree[2 * node + 1]
+        tree = tree.at[node].set(child_sum)
+    return tree
+
+
+def sample(tree: jax.Array, rng: jax.Array, batch: int
+           ) -> tuple[jax.Array, jax.Array]:
+    """Stratified proportional sampling.
+
+    Returns (leaf_idx [batch] int32, probs [batch] f32) where probs are
+    normalized leaf probabilities p_i / total. Stratification: sample i
+    draws uniformly from the i-th of `batch` equal slices of the total
+    mass (variance reduction, as in standard PER implementations).
+    """
+    cap = capacity_of(tree)
+    depth = cap.bit_length() - 1
+    tot = tree[1]
+    u = (jnp.arange(batch, dtype=jnp.float32)
+         + jax.random.uniform(rng, (batch,))) / batch * tot
+    idx = jnp.ones(batch, jnp.int32)
+    for _ in range(depth):
+        left = tree[2 * idx]
+        go_right = u >= left
+        u = jnp.where(go_right, u - left, u)
+        idx = 2 * idx + go_right.astype(jnp.int32)
+    leaf = idx - cap
+    probs = tree[idx] / jnp.maximum(tot, 1e-12)
+    return leaf, probs
+
+
+@partial(jax.jit, static_argnums=(2,))
+def sample_jit(tree, rng, batch):
+    return sample(tree, rng, batch)
+
+
+update_jit = jax.jit(update, donate_argnums=(0,))

@@ -1,0 +1,195 @@
+"""Multi-chip learner: replay-sharded data parallelism + tensor-parallel
+dense layers over a (dp, tp) mesh.
+
+Reference parity (SURVEY.md §2.3): the reference's NCCL grad all-reduce
+becomes an XLA-inserted psum over ICI; its host sum-tree becomes dp
+per-shard device sum-trees.
+
+Design (the "pick a mesh, annotate shardings, let XLA insert
+collectives" recipe):
+- Replay state carries a leading [dp] axis on every array (storage
+  [dp, cap_shard, ...], tree [dp, 2*cap_shard], pos/size/rng [dp]),
+  sharded `P("dp")`. Replay ops are `jax.vmap`s of the single-shard
+  pure functions — under GSPMD each mesh row executes only its own
+  slice, so sampling/priority-updates never cross ICI.
+- Each shard draws batch/dp samples from its own tree (stratified
+  within shard); IS weights use the global fill N = sum of shard sizes
+  and a global max-normalization (one tiny psum).
+- The loss/grad runs on the flattened [dp*b_local] batch with a
+  sharding constraint P("dp"); the batch-mean makes GSPMD emit the
+  gradient psum over "dp" — the NCCL all-reduce equivalent.
+- Large dense kernels are column-sharded over "tp"
+  (parallel.sharding.make_param_shardings); optimizer state inherits
+  param shardings by initializing it under jit with sharded inputs.
+
+Ingest expects items pre-split per shard: [dp, B_ingest, ...]. The
+host-side driver round-robins actor transitions across shards.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ape_x_dqn_tpu.ops import sum_tree
+from ape_x_dqn_tpu.ops.losses import TransitionBatch, make_dqn_loss
+from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
+from ape_x_dqn_tpu.parallel.sharding import make_param_shardings
+from ape_x_dqn_tpu.runtime.learner import make_optimizer
+
+
+class DistTrainState(NamedTuple):
+    params: Any
+    target_params: Any
+    opt_state: Any
+    replay: ReplayState   # every leaf has a leading [dp] axis
+    rng: jax.Array        # [dp] keys
+    step: jax.Array       # scalar int32
+
+
+class DistDQNLearner:
+    def __init__(self, net_apply: Callable, replay: PrioritizedReplay,
+                 lcfg, mesh: Mesh,
+                 optimizer: optax.GradientTransformation | None = None):
+        """`replay` is configured with the PER-SHARD capacity."""
+        self.net_apply = net_apply
+        self.replay = replay
+        self.lcfg = lcfg
+        self.mesh = mesh
+        self.dp = mesh.shape["dp"]
+        assert lcfg.batch_size % self.dp == 0, \
+            "batch_size must divide by dp"
+        self.b_local = lcfg.batch_size // self.dp
+        self.optimizer = optimizer or make_optimizer(lcfg)
+        self.loss_fn = make_dqn_loss(
+            net_apply, double=lcfg.double_dqn, huber_delta=lcfg.huber_delta,
+            rescale=lcfg.value_rescale)
+        self._dp_sharding = NamedSharding(mesh, P("dp"))
+        self._repl_sharding = NamedSharding(mesh, P())
+
+    # -- state construction ------------------------------------------------
+
+    def init(self, params: Any, item_spec: Any,
+             rng: jax.Array) -> DistTrainState:
+        param_shardings = make_param_shardings(params, self.mesh)
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s),
+            params, param_shardings)
+        target = jax.tree.map(jnp.copy, params)
+        opt_state = jax.jit(self.optimizer.init)(params)
+
+        def one_shard_replay(_):
+            return self.replay.init(item_spec)
+
+        # out_shardings avoids ever materializing the full replicated
+        # buffer: each shard's storage is allocated on its own mesh row
+        replay0 = jax.jit(
+            jax.vmap(one_shard_replay),
+            out_shardings=jax.tree.map(lambda _: self._dp_sharding,
+                                       jax.eval_shape(
+                                           jax.vmap(one_shard_replay),
+                                           jnp.arange(self.dp))),
+        )(jnp.arange(self.dp))
+        rngs = jax.device_put(jax.random.split(rng, self.dp),
+                              self._dp_sharding)
+        return DistTrainState(params, target, opt_state, replay0, rngs,
+                              jnp.int32(0))
+
+    # -- pure step ---------------------------------------------------------
+
+    def _train_step(self, state: DistTrainState
+                    ) -> tuple[DistTrainState, dict]:
+        keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
+        rng, sk = keys[:, 0], keys[:, 1]
+
+        # per-shard stratified sampling from per-shard trees (no ICI)
+        def shard_sample(rstate: ReplayState, key):
+            idx, probs = sum_tree.sample(rstate.tree, key, self.b_local)
+            items = jax.tree.map(lambda buf: buf[idx], rstate.storage)
+            return items, idx, probs
+
+        items, idx, probs = jax.vmap(shard_sample)(state.replay, sk)
+
+        # global IS weights: N = total filled slots across shards; the
+        # global sampling probability is approximated as probs/dp (exact
+        # when shard priority masses are balanced, which round-robin
+        # ingest keeps true in expectation)
+        n_global = jnp.maximum(
+            state.replay.size.astype(jnp.float32).sum(), 1.0)
+        w = (n_global * jnp.maximum(probs / self.dp, 1e-12)
+             ) ** (-self.replay.beta)
+        w = w / jnp.maximum(w.max(), 1e-12)
+
+        def flat(x):
+            y = x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+            return jax.lax.with_sharding_constraint(
+                y, self._dp_sharding)
+
+        batch = TransitionBatch(
+            obs=flat(items["obs"]), actions=flat(items["action"]),
+            rewards=flat(items["reward"]), next_obs=flat(items["next_obs"]),
+            discounts=flat(items["discount"]))
+        (loss, aux), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(
+            state.params, state.target_params, batch, flat(w))
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        # per-shard priority write-back
+        td_shard = aux["td_abs"].reshape(self.dp, self.b_local)
+        new_replay = jax.vmap(
+            lambda rs, i, td: self.replay.update_priorities(rs, i, td)
+        )(state.replay, idx, td_shard)
+
+        step = state.step + 1
+        sync = (step % self.lcfg.target_sync_every == 0)
+        target_params = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), state.target_params, params)
+        metrics = {"loss": loss, "q_mean": aux["q_mean"],
+                   "td_abs_mean": aux["td_abs"].mean(),
+                   "grad_norm": optax.global_norm(grads)}
+        return DistTrainState(params, target_params, opt_state, new_replay,
+                              rng, step), metrics
+
+    # -- jitted endpoints --------------------------------------------------
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state: DistTrainState):
+        return self._train_step(state)
+
+    @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
+    def train_many(self, state: DistTrainState, n: int):
+        def body(s, _):
+            s, m = self._train_step(s)
+            return s, m
+        state, metrics = jax.lax.scan(body, state, None, length=n)
+        return state, jax.tree.map(lambda x: x[-1], metrics)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add(self, state: DistTrainState, items: Any,
+            td_abs: jax.Array) -> DistTrainState:
+        """items: pytree of [dp, B, ...]; td_abs: [dp, B]."""
+        items = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                jnp.asarray(x), self._dp_sharding), items)
+        new_replay = jax.vmap(
+            lambda rs, it, td: self.replay.add(rs, it, td)
+        )(state.replay, items, td_abs)
+        return state._replace(replay=new_replay)
+
+    # -- weight publication (learner -> inference server over ICI) --------
+
+    def publish_params(self, state: DistTrainState) -> Any:
+        """Fully-replicated param copy for the actor inference server.
+
+        The tp all-gather happens over ICI (XLA resharding), mirroring
+        the reference's learner->actor weight broadcast (SURVEY.md §2.3
+        item 3), without interrupting train_many dispatches.
+        """
+        return jax.device_put(state.params, self._repl_sharding)

@@ -1,0 +1,40 @@
+"""Device mesh construction.
+
+The reference scales with NCCL data-parallel collectives (SURVEY.md §2.3
+item 2); here the learner scales over a `jax.sharding.Mesh` with named
+axes and XLA-inserted collectives over ICI:
+
+- "dp": data parallel — replay shards + batch shards + gradient psum.
+- "tp": tensor parallel — large dense kernels column/row-sharded.
+
+An Ape-X system has no pipeline/sequence/expert parallelism to express
+(SURVEY.md §2.4): networks are small CNNs/LSTMs, so dp x tp is the
+complete, honest mesh. R2D2 sequences shard across the batch axis (dp),
+never time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: int | None = None, tp: int = 1,
+              devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if dp is None:
+        dp = n // tp
+    assert 1 <= dp * tp <= n, f"dp({dp}) * tp({tp}) > device count ({n})"
+    arr = np.asarray(devices[:dp * tp]).reshape(dp, tp)
+    return Mesh(arr, axis_names=("dp", "tp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over dp (replay shards, batches)."""
+    return NamedSharding(mesh, P("dp"))

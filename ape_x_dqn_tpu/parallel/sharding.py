@@ -1,0 +1,49 @@
+"""Parameter sharding rules (tensor parallelism for dense layers).
+
+The Nature-CNN's FLOPs concentrate in the flatten->512 dense layer
+(3136x512) and the LSTM kernels; those shard over the "tp" mesh axis
+(column-parallel: output features split, XLA all-gathers activations as
+needed). Conv kernels and small heads replicate — sharding them would
+cost more in collectives than it saves.
+
+This follows the standard JAX recipe: annotate param shardings, let
+GSPMD insert the collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_pspec(path: tuple, leaf, tp: int, min_dim: int = 256) -> P:
+    """PartitionSpec for one parameter.
+
+    Column-shard 2D dense kernels whose output dim is large and divisible
+    by tp; shard matching biases; replicate everything else.
+    """
+    if tp <= 1:
+        return P()
+    shape = leaf.shape
+    if len(shape) == 2 and shape[1] % tp == 0 and shape[1] >= min_dim:
+        return P(None, "tp")
+    if len(shape) == 1 and shape[0] % tp == 0 and shape[0] >= min_dim:
+        return P("tp")
+    return P()
+
+
+def make_param_shardings(params: Any, mesh: Mesh,
+                         min_dim: int = 256) -> Any:
+    """Pytree of NamedShardings matching `params`."""
+    tp = mesh.shape.get("tp", 1)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(
+            mesh, param_pspec(path, leaf, tp, min_dim)),
+        params)
+
+
+def shard_params(params: Any, mesh: Mesh, min_dim: int = 256) -> Any:
+    shardings = make_param_shardings(params, mesh, min_dim)
+    return jax.tree.map(jax.device_put, params, shardings)

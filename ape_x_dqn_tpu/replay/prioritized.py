@@ -1,0 +1,146 @@
+"""Device-resident prioritized replay.
+
+Storage (an arbitrary transition pytree of per-slot arrays) and the
+sum-tree both live in HBM; add / sample / priority-update are pure
+functions designed to be fused into the learner's single jit (SURVEY.md
+§7 step 5, §2.3 item 5). The learner is the single owner of the buffer
+state, which removes the sample-vs-update race of host-side designs by
+construction (SURVEY.md §5 "race detection").
+
+Conventions (Schaul et al. 2016; Horgan et al. 2018):
+- stored priority = (|td| + eps)^alpha  (alpha applied at write time)
+- IS weight w_i = (N * P(i))^-beta, normalized by max over the batch
+- new transitions arrive WITH priorities (actors compute initial
+  priorities actor-side — SURVEY.md §2.2 "Actor runtime")
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ape_x_dqn_tpu.ops import sum_tree
+
+
+class ReplayState(NamedTuple):
+    storage: Any          # pytree of [capacity, ...] arrays
+    tree: jax.Array       # (2*capacity,) sum-tree of p^alpha
+    pos: jax.Array        # int32 next write cursor
+    size: jax.Array       # int32 filled slots
+
+
+class PrioritizedReplay:
+    """Static config + pure state-transition functions."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, beta: float = 0.4,
+                 eps: float = 1e-6):
+        assert capacity > 0 and (capacity & (capacity - 1)) == 0, \
+            "capacity must be a power of two"
+        self.capacity = capacity
+        self.alpha = alpha
+        self.beta = beta
+        self.eps = eps
+
+    # -- state construction ------------------------------------------------
+
+    def init(self, item_spec: Any) -> ReplayState:
+        """item_spec: pytree of ShapeDtypeStruct (or arrays) for ONE item."""
+        storage = jax.tree.map(
+            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype),
+            item_spec)
+        return ReplayState(
+            storage=storage, tree=sum_tree.init(self.capacity),
+            pos=jnp.int32(0), size=jnp.int32(0))
+
+    # -- transitions (all pure, jit-friendly) ------------------------------
+
+    def add(self, state: ReplayState, items: Any,
+            td_abs: jax.Array) -> ReplayState:
+        """Append a batch of items with initial |TD| priorities.
+
+        items: pytree of [B, ...] arrays; td_abs: [B] f32.
+        Overwrites FIFO when full (ring semantics via modular cursor).
+        """
+        b = td_abs.shape[0]
+        idx = (state.pos + jnp.arange(b, dtype=jnp.int32)) % self.capacity
+        storage = jax.tree.map(
+            lambda buf, x: buf.at[idx].set(x.astype(buf.dtype)),
+            state.storage, items)
+        pri = (td_abs + self.eps) ** self.alpha
+        tree = sum_tree.update(state.tree, idx, pri)
+        return ReplayState(
+            storage=storage, tree=tree,
+            pos=(state.pos + b) % self.capacity,
+            size=jnp.minimum(state.size + b, self.capacity))
+
+    def sample(self, state: ReplayState, rng: jax.Array, batch: int
+               ) -> tuple[Any, jax.Array, jax.Array]:
+        """-> (item batch pytree, leaf indices [B], IS weights [B])."""
+        idx, probs = sum_tree.sample(state.tree, rng, batch)
+        items = jax.tree.map(lambda buf: buf[idx], state.storage)
+        n = jnp.maximum(state.size.astype(jnp.float32), 1.0)
+        w = (n * jnp.maximum(probs, 1e-12)) ** (-self.beta)
+        w = w / jnp.maximum(w.max(), 1e-12)
+        return items, idx, w
+
+    def update_priorities(self, state: ReplayState, idx: jax.Array,
+                          td_abs: jax.Array) -> ReplayState:
+        pri = (td_abs + self.eps) ** self.alpha
+        return state._replace(tree=sum_tree.update(state.tree, idx, pri))
+
+    # -- convenience jitted endpoints (standalone use / replay server) -----
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add_jit(self, state, items, td_abs):
+        return self.add(state, items, td_abs)
+
+    @partial(jax.jit, static_argnums=(0, 3))
+    def sample_jit(self, state, rng, batch):
+        return self.sample(state, rng, batch)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def update_priorities_jit(self, state, idx, td_abs):
+        return self.update_priorities(state, idx, td_abs)
+
+
+class UniformReplayDevice:
+    """Uniform ring buffer with the same pure-functional API (config 1).
+
+    Sampling is uniform over filled slots; IS weights are all ones.
+    """
+
+    def __init__(self, capacity: int):
+        assert capacity > 0 and (capacity & (capacity - 1)) == 0
+        self.capacity = capacity
+
+    def init(self, item_spec: Any) -> ReplayState:
+        storage = jax.tree.map(
+            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype),
+            item_spec)
+        return ReplayState(storage=storage,
+                           tree=jnp.zeros(1, jnp.float32),  # unused
+                           pos=jnp.int32(0), size=jnp.int32(0))
+
+    def add(self, state: ReplayState, items: Any,
+            td_abs: jax.Array | None = None) -> ReplayState:
+        b = jax.tree.leaves(items)[0].shape[0]
+        idx = (state.pos + jnp.arange(b, dtype=jnp.int32)) % self.capacity
+        storage = jax.tree.map(
+            lambda buf, x: buf.at[idx].set(x.astype(buf.dtype)),
+            state.storage, items)
+        return ReplayState(
+            storage=storage, tree=state.tree,
+            pos=(state.pos + b) % self.capacity,
+            size=jnp.minimum(state.size + b, self.capacity))
+
+    def sample(self, state: ReplayState, rng: jax.Array, batch: int):
+        idx = jax.random.randint(rng, (batch,), 0,
+                                 jnp.maximum(state.size, 1))
+        items = jax.tree.map(lambda buf: buf[idx], state.storage)
+        return items, idx, jnp.ones(batch, jnp.float32)
+
+    def update_priorities(self, state: ReplayState, idx, td_abs):
+        return state

@@ -1,0 +1,117 @@
+"""R2D2 sequence replay: host-side sequence assembly + device storage.
+
+Actors assemble fixed-length overlapping sequences with the recurrent
+state stored from *before* the first step (SURVEY.md §2.2 "Sequence
+replay", §3.4); the sequences are then items in the generic
+device-resident PrioritizedReplay, so sampling/priority updates run
+inside the learner jit exactly like flat transitions.
+
+Defaults follow Kapturowski et al. 2019: length 80, overlap 40
+(adjacent sequences share half their steps), burn-in 40 handled by the
+loss, priority = eta*max|td| + (1-eta)*mean|td|.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def sequence_item_spec(obs_shape: tuple[int, ...], obs_dtype,
+                       seq_len: int, lstm_size: int) -> dict:
+    """ShapeDtypeStruct-style pytree describing ONE stored sequence."""
+    import jax
+    f32 = np.float32
+    return {
+        "obs": jax.ShapeDtypeStruct((seq_len, *obs_shape), obs_dtype),
+        "actions": jax.ShapeDtypeStruct((seq_len,), np.int32),
+        "rewards": jax.ShapeDtypeStruct((seq_len,), f32),
+        "terminals": jax.ShapeDtypeStruct((seq_len,), f32),
+        "mask": jax.ShapeDtypeStruct((seq_len,), f32),
+        "init_c": jax.ShapeDtypeStruct((lstm_size,), f32),
+        "init_h": jax.ShapeDtypeStruct((lstm_size,), f32),
+    }
+
+
+class SequenceBuilder:
+    """Per-env accumulator emitting overlapping fixed-length sequences."""
+
+    def __init__(self, seq_len: int = 80, overlap: int = 40,
+                 lstm_size: int = 512):
+        assert 0 <= overlap < seq_len
+        self.seq_len = seq_len
+        self.overlap = overlap
+        self.lstm_size = lstm_size
+        self._steps: list[dict] = []  # each: obs/action/reward/terminal/pre_c/pre_h
+        self._retained = 0  # leading steps already covered by a prior emit
+
+    def append(self, obs, action, reward, terminal: bool,
+               pre_state: tuple[np.ndarray, np.ndarray]) -> list[dict]:
+        """Add one step; pre_state is the (c, h) fed to the net AT this step.
+
+        Returns 0+ completed sequence items (dicts matching
+        sequence_item_spec).
+        """
+        c, h = pre_state
+        self._steps.append(dict(
+            obs=np.asarray(obs), action=int(action), reward=float(reward),
+            terminal=bool(terminal),
+            pre_c=np.asarray(c, np.float32).reshape(-1),
+            pre_h=np.asarray(h, np.float32).reshape(-1)))
+        out = []
+        if len(self._steps) == self.seq_len:
+            out.append(self._emit(self._steps))
+            # retain the trailing overlap as the head of the next sequence
+            self._steps = self._steps[self.seq_len - self.overlap:] \
+                if self.overlap else []
+            self._retained = len(self._steps)
+        if terminal:
+            # flush the padded partial tail, but only if it contains steps
+            # not already covered by the previous emit's overlap
+            if len(self._steps) > self._retained:
+                out.append(self._emit(self._steps))
+            self._steps = []
+            self._retained = 0
+        return out
+
+    def reset(self) -> None:
+        self._steps = []
+        self._retained = 0
+
+    def _emit(self, steps: list[dict]) -> dict:
+        n = len(steps)
+        assert n > 0
+        length = self.seq_len
+        first = steps[0]
+        obs = np.zeros((length, *first["obs"].shape), first["obs"].dtype)
+        actions = np.zeros(length, np.int32)
+        rewards = np.zeros(length, np.float32)
+        terminals = np.zeros(length, np.float32)
+        mask = np.zeros(length, np.float32)
+        for i, s in enumerate(steps):
+            obs[i] = s["obs"]
+            actions[i] = s["action"]
+            rewards[i] = s["reward"]
+            terminals[i] = float(s["terminal"])
+            mask[i] = 1.0
+        return {
+            "obs": obs, "actions": actions, "rewards": rewards,
+            "terminals": terminals, "mask": mask,
+            "init_c": first["pre_c"], "init_h": first["pre_h"],
+        }
+
+
+def stack_items(items: list[dict]) -> dict:
+    """Stack a list of sequence items into a batch pytree of [B, ...]."""
+    return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
+def batch_to_sequence_batch(items: Any):
+    """Device item batch (dict of [B, L, ...]) -> losses.SequenceBatch."""
+    from ape_x_dqn_tpu.ops.losses import SequenceBatch
+    return SequenceBatch(
+        obs=items["obs"], actions=items["actions"],
+        rewards=items["rewards"], terminals=items["terminals"],
+        mask=items["mask"],
+        init_state=(items["init_c"], items["init_h"]))

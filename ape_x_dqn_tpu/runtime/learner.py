@@ -1,0 +1,137 @@
+"""The learner: sample -> loss -> update -> priority write-back, one jit.
+
+This is the reference's hot loop (SURVEY.md §3.3) rebuilt TPU-first: the
+reference fuses forward/backward/optimizer in CUDA and keeps its sum-tree
+on the host; here the *entire* cycle — stratified sum-tree sampling,
+batch gather from HBM storage, n-step double-DQN Huber loss, optimizer
+update, priority write-back, and periodic target sync — is one XLA graph
+with the learner state donated (no host round-trips, no copies).
+
+`make_dqn_learner` also exposes `train_many`, a `lax.scan` over K steps,
+so the device runs unattended for K grad-steps per dispatch — this is
+what the benchmark (bench.py) measures.
+
+Replay ingest (`add`) is a separate donated jit: the actor/ingest thread
+feeds device-resident storage while the learner thread owns training.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ape_x_dqn_tpu.ops.losses import TransitionBatch, make_dqn_loss
+from ape_x_dqn_tpu.replay.prioritized import ReplayState
+
+
+class TrainState(NamedTuple):
+    params: Any
+    target_params: Any
+    opt_state: Any
+    replay: ReplayState
+    rng: jax.Array
+    step: jax.Array  # int32 grad-step counter
+
+
+def transition_item_spec(obs_shape, obs_dtype) -> dict:
+    """Item pytree spec for one flat n-step transition (discrete actions)."""
+    return {
+        "obs": jax.ShapeDtypeStruct(obs_shape, obs_dtype),
+        "action": jax.ShapeDtypeStruct((), jnp.int32),
+        "reward": jax.ShapeDtypeStruct((), jnp.float32),
+        "next_obs": jax.ShapeDtypeStruct(obs_shape, obs_dtype),
+        "discount": jax.ShapeDtypeStruct((), jnp.float32),
+    }
+
+
+def make_optimizer(lcfg) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(lcfg.max_grad_norm),
+        optax.adam(lcfg.lr, eps=lcfg.adam_eps),
+    )
+
+
+class DQNLearner:
+    """Builds the jitted endpoints for a flat-transition DQN learner."""
+
+    def __init__(self, net_apply: Callable, replay, lcfg,
+                 optimizer: optax.GradientTransformation | None = None):
+        self.net_apply = net_apply
+        self.replay = replay
+        self.lcfg = lcfg
+        self.optimizer = optimizer or make_optimizer(lcfg)
+        self.loss_fn = make_dqn_loss(
+            net_apply, double=lcfg.double_dqn, huber_delta=lcfg.huber_delta,
+            rescale=lcfg.value_rescale)
+
+    # -- state ------------------------------------------------------------
+
+    def init(self, params: Any, replay_state: ReplayState,
+             rng: jax.Array) -> TrainState:
+        return TrainState(
+            params=params,
+            # real copies: params and target_params are donated together,
+            # so they must not alias the same device buffers
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=self.optimizer.init(params),
+            replay=replay_state,
+            rng=rng,
+            step=jnp.int32(0))
+
+    # -- core step (pure) -------------------------------------------------
+
+    def _train_step(self, state: TrainState) -> tuple[TrainState, dict]:
+        rng, sk = jax.random.split(state.rng)
+        items, idx, is_w = self.replay.sample(
+            state.replay, sk, self.lcfg.batch_size)
+        batch = TransitionBatch(
+            obs=items["obs"], actions=items["action"],
+            rewards=items["reward"], next_obs=items["next_obs"],
+            discounts=items["discount"])
+        (loss, aux), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(
+            state.params, state.target_params, batch, is_w)
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        replay_state = self.replay.update_priorities(
+            state.replay, idx, aux["td_abs"])
+        step = state.step + 1
+        # hard target sync every K steps, branchless (SURVEY.md §3.3)
+        sync = (step % self.lcfg.target_sync_every == 0)
+        target_params = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), state.target_params, params)
+        metrics = {
+            "loss": loss,
+            "q_mean": aux["q_mean"],
+            "td_abs_mean": aux["td_abs"].mean(),
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = TrainState(params, target_params, opt_state,
+                               replay_state, rng, step)
+        return new_state, metrics
+
+    # -- jitted endpoints --------------------------------------------------
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state: TrainState):
+        return self._train_step(state)
+
+    @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
+    def train_many(self, state: TrainState, n: int):
+        """n grad-steps in one dispatch via lax.scan (bench hot path)."""
+        def body(s, _):
+            s, m = self._train_step(s)
+            return s, m
+        state, metrics = jax.lax.scan(body, state, None, length=n)
+        return state, jax.tree.map(lambda x: x[-1], metrics)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add(self, state: TrainState, items: Any,
+            td_abs: jax.Array) -> TrainState:
+        return state._replace(
+            replay=self.replay.add(state.replay, items, td_abs))

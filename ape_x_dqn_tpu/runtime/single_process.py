@@ -1,0 +1,139 @@
+"""Single-process training driver — the reference's CPU smoke path.
+
+Config 1 (SURVEY.md §3.5): one env, one net, device-resident replay, and
+the single-jit learner, all in one process with no transport. This is
+both the minimum end-to-end slice and the correctness oracle (CartPole
+must reach >= 475 average return).
+
+Works for any flat-transition discrete config (CartPole MLP, synthetic
+Atari CNN) — the distributed runtime (runtime/driver.py) reuses the same
+learner and replay, swapping the in-process env loop for actor processes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.ops.nstep import NStepBuilder
+from ape_x_dqn_tpu.replay.prioritized import (
+    PrioritizedReplay, UniformReplayDevice)
+from ape_x_dqn_tpu.runtime.learner import (
+    DQNLearner, transition_item_spec)
+from ape_x_dqn_tpu.utils.metrics import Metrics
+from ape_x_dqn_tpu.utils.rng import RngStream, component_key
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def build_replay(rcfg):
+    cap = _next_pow2(rcfg.capacity)
+    if rcfg.kind == "uniform":
+        return UniformReplayDevice(capacity=cap)
+    return PrioritizedReplay(capacity=cap, alpha=rcfg.alpha, beta=rcfg.beta,
+                             eps=rcfg.eps)
+
+
+def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
+                         metrics: Metrics | None = None,
+                         solve_return: float | None = None,
+                         train_every: int = 1,
+                         flush_every: int = 32) -> dict:
+    """Run config-1-style training; returns summary stats."""
+    total = total_env_frames or cfg.total_env_frames
+    metrics = metrics or Metrics()
+    env = make_env(cfg.env, seed=cfg.seed)
+    net = build_network(cfg.network, env.spec)
+
+    obs = env.reset()
+    params = net.init(component_key(cfg.seed, "net_init"), obs[None])
+    fwd = jax.jit(net.apply)
+
+    replay = build_replay(cfg.replay)
+    item_spec = transition_item_spec(env.spec.obs_shape,
+                                     env.spec.obs_dtype)
+    learner = DQNLearner(net.apply, replay, cfg.learner)
+    state = learner.init(params, replay.init(item_spec),
+                         component_key(cfg.seed, "learner"))
+
+    nstep = NStepBuilder(cfg.learner.n_step, cfg.learner.gamma)
+    actor_rng = np.random.default_rng(
+        RngStream(cfg.seed, "actor_host").next_uint32())
+
+    pending: list = []
+    returns: deque[float] = deque(maxlen=100)
+    losses: deque[float] = deque(maxlen=100)
+    frames = 0
+    grad_steps = 0
+    eps_final = 0.05
+    eps_decay_frames = max(total // 10, 1_000)
+
+    def flush():
+        nonlocal pending, state
+        if not pending:
+            return
+        items = {
+            "obs": jnp.asarray(np.stack([t.obs for t in pending])),
+            "action": jnp.asarray([t.action for t in pending], jnp.int32),
+            "reward": jnp.asarray([t.reward for t in pending], jnp.float32),
+            "next_obs": jnp.asarray(np.stack([t.next_obs for t in pending])),
+            "discount": jnp.asarray([t.discount for t in pending],
+                                    jnp.float32),
+        }
+        state = learner.add(state, items, jnp.ones(len(pending)))
+        pending = []
+
+    while frames < total:
+        eps = max(eps_final, 1.0 - (1.0 - eps_final) * frames
+                  / eps_decay_frames)
+        if actor_rng.random() < eps:
+            action = int(actor_rng.integers(env.spec.num_actions))
+        else:
+            q = fwd(state.params, obs[None])
+            action = int(jnp.argmax(q[0]))
+        next_obs, reward, done, info = env.step(action)
+        frames += 1
+        truncated = done and not info.get("terminal", done)
+        pending.extend(nstep.append(obs, action, reward, next_obs,
+                                    info.get("terminal", done), truncated))
+        obs = env.reset() if done else next_obs
+        if done and "episode_return" in info:
+            returns.append(info["episode_return"])
+
+        if len(pending) >= flush_every:
+            flush()
+
+        if (int(state.replay.size) + len(pending) >= cfg.replay.min_fill
+                and frames % train_every == 0):
+            flush()
+            state, m = learner.train_step(state)
+            grad_steps += 1
+            losses.append(float(m["loss"]))
+            if grad_steps % 500 == 0:
+                metrics.log(grad_steps, frames=frames,
+                            loss=float(m["loss"]),
+                            q_mean=float(m["q_mean"]),
+                            avg_return=(float(np.mean(returns))
+                                        if returns else 0.0),
+                            eps=eps)
+        if (solve_return is not None and len(returns) >= 20
+                and np.mean(list(returns)[-20:]) >= solve_return):
+            break
+
+    return {
+        "frames": frames,
+        "grad_steps": grad_steps,
+        "avg_return": float(np.mean(returns)) if returns else 0.0,
+        "last20_return": (float(np.mean(list(returns)[-20:]))
+                          if len(returns) >= 1 else 0.0),
+        "episodes": len(returns),
+        "final_loss": float(np.mean(losses)) if losses else float("nan"),
+    }

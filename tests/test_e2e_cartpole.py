@@ -1,0 +1,29 @@
+"""Config-1 end-to-end integration (SURVEY.md §4 'integration').
+
+The full act -> store -> sample -> jit-update -> target-sync loop in one
+process. The quick test asserts learning progress; the slow test is the
+canonical solve (>= 475 average over last 20 episodes).
+"""
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.configs import get_config
+from ape_x_dqn_tpu.runtime.single_process import train_single_process
+
+
+def test_cartpole_learns_quick():
+    cfg = get_config("cartpole_smoke", seed=0)
+    out = train_single_process(cfg, total_env_frames=9_000)
+    # untrained/random policy averages ~20; require clear learning signal
+    assert out["episodes"] >= 5
+    assert out["last20_return"] > 60.0, out
+
+
+@pytest.mark.slow
+def test_cartpole_solves():
+    cfg = get_config("cartpole_smoke", seed=0)
+    out = train_single_process(cfg, total_env_frames=120_000,
+                               solve_return=475.0)
+    assert out["last20_return"] >= 475.0, out
+    assert out["frames"] < 120_000  # early-stopped on solve

@@ -80,9 +80,10 @@ def test_atari_preprocessing_pipeline():
     assert obs2.shape == (84, 84, 4)
     assert r in (-1.0, 0.0, 1.0)  # clipped
     assert "lives" in info and "terminal" in info
-    # frame stack shifts: oldest plane of obs2 is second plane of obs... only
-    # guaranteed when both are post-reset consecutive; check newest differs
-    assert not np.array_equal(obs2[..., 3], obs2[..., 2]) or True
+    # frame stack shifts by one plane per step
+    obs3, _, _, _ = env.step(0)
+    np.testing.assert_array_equal(obs3[..., 2], obs2[..., 3])
+    np.testing.assert_array_equal(obs3[..., 1], obs2[..., 2])
 
 
 def test_atari_maxpool_defeats_flicker():

@@ -1,0 +1,132 @@
+"""Distributed learner on the virtual 8-device CPU mesh (SURVEY.md §4
+"distributed-without-a-cluster")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.configs import LearnerConfig, NetworkConfig
+from ape_x_dqn_tpu.envs.base import EnvSpec
+from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
+from ape_x_dqn_tpu.parallel.mesh import make_mesh
+from ape_x_dqn_tpu.parallel.sharding import make_param_shardings
+from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+from ape_x_dqn_tpu.runtime.learner import transition_item_spec
+
+VEC_SPEC = EnvSpec(obs_shape=(4,), obs_dtype=np.dtype(np.float32),
+                   discrete=True, num_actions=2)
+
+
+def _make_dist(dp=4, tp=2, batch=32):
+    mesh = make_mesh(dp=dp, tp=tp)
+    net = build_network(
+        NetworkConfig(kind="mlp", mlp_hidden=(256,), dueling=False,
+                      compute_dtype="float32"), VEC_SPEC)
+    params = net.init(jax.random.key(0), jnp.zeros((1, 4)))
+    lcfg = LearnerConfig(batch_size=batch, target_sync_every=10)
+    replay = PrioritizedReplay(capacity=64, alpha=0.6, beta=0.4)
+    learner = DistDQNLearner(net.apply, replay, lcfg, mesh)
+    spec = transition_item_spec((4,), jnp.float32)
+    state = learner.init(params, spec, jax.random.key(1))
+    return mesh, learner, state
+
+
+def _ingest(learner, state, dp, n_per_shard, seed=0):
+    rng = np.random.default_rng(seed)
+    items = {
+        "obs": jnp.asarray(rng.normal(size=(dp, n_per_shard, 4)),
+                           jnp.float32),
+        "action": jnp.asarray(rng.integers(0, 2, (dp, n_per_shard)),
+                              jnp.int32),
+        "reward": jnp.asarray(rng.normal(size=(dp, n_per_shard)),
+                              jnp.float32),
+        "next_obs": jnp.asarray(rng.normal(size=(dp, n_per_shard, 4)),
+                                jnp.float32),
+        "discount": jnp.full((dp, n_per_shard), 0.99, jnp.float32),
+    }
+    return learner.add(state, items, jnp.ones((dp, n_per_shard)))
+
+
+def test_mesh_construction():
+    mesh = make_mesh(dp=4, tp=2)
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    with pytest.raises(AssertionError):
+        make_mesh(dp=3, tp=3)
+
+
+def test_param_shardings_tp():
+    mesh = make_mesh(dp=4, tp=2)
+    net = build_network(
+        NetworkConfig(kind="mlp", mlp_hidden=(256,), dueling=False,
+                      compute_dtype="float32"), VEC_SPEC)
+    params = net.init(jax.random.key(0), jnp.zeros((1, 4)))
+    sh = make_param_shardings(params, mesh)
+    flat = jax.tree_util.tree_flatten_with_path(sh)[0]
+    specs = {jax.tree_util.keystr(p): s.spec for p, s in flat}
+    # the 4x256 hidden kernel is column-sharded; the 256x2 head replicated
+    assert any(s == jax.sharding.PartitionSpec(None, "tp")
+               for s in specs.values())
+
+
+def test_dist_replay_state_sharded():
+    dp = 4
+    mesh, learner, state = _make_dist(dp=dp, tp=2)
+    assert state.replay.tree.shape == (dp, 2 * 64)
+    assert state.rng.shape[0] == dp
+    # storage leaves carry the leading dp axis and a dp sharding
+    assert state.replay.storage["obs"].shape == (dp, 64, 4)
+    spec = state.replay.storage["obs"].sharding.spec
+    assert spec and spec[0] == "dp"
+
+
+def test_dist_train_step_runs_and_syncs():
+    dp = 4
+    mesh, learner, state = _make_dist(dp=dp, tp=2, batch=32)
+    state = _ingest(learner, state, dp, 16)
+    assert int(np.asarray(state.replay.size).sum()) == dp * 16
+    p0 = np.asarray(jax.tree.leaves(state.params)[0])  # copy: state is donated
+    for _ in range(3):
+        state, m = learner.train_step(state)
+    assert np.isfinite(float(m["loss"]))
+    assert int(state.step) == 3
+    # params changed
+    p1 = np.asarray(jax.tree.leaves(state.params)[0])
+    assert not np.allclose(p0, p1)
+    # target sync at step 10
+    for _ in range(7):
+        state, m = learner.train_step(state)
+    tp_, pp_ = jax.tree.leaves(state.target_params), jax.tree.leaves(
+        state.params)
+    for a, b in zip(tp_, pp_):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_dist_matches_priorities_locally():
+    """Priority write-back stays shard-local: sampled indices from shard
+    d update shard d's tree only."""
+    dp = 2
+    mesh, learner, state = _make_dist(dp=dp, tp=1, batch=8)
+    state = _ingest(learner, state, dp, 8)
+    trees_before = np.asarray(state.replay.tree)
+    state, m = learner.train_step(state)
+    trees_after = np.asarray(state.replay.tree)
+    # both shard trees were touched (each shard sampled and updated)
+    assert not np.allclose(trees_before[0], trees_after[0])
+    assert not np.allclose(trees_before[1], trees_after[1])
+
+
+def test_train_many_scan():
+    dp = 4
+    mesh, learner, state = _make_dist(dp=dp, tp=2, batch=32)
+    state = _ingest(learner, state, dp, 16)
+    state, m = learner.train_many(state, 5)
+    assert int(state.step) == 5 and np.isfinite(float(m["loss"]))
+
+
+def test_publish_params_replicated():
+    mesh, learner, state = _make_dist(dp=4, tp=2)
+    pub = learner.publish_params(state)
+    for leaf in jax.tree.leaves(pub):
+        assert leaf.sharding.is_fully_replicated

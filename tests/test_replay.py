@@ -1,0 +1,219 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.ops import sum_tree
+from ape_x_dqn_tpu.replay.prioritized import (
+    PrioritizedReplay, UniformReplayDevice)
+from ape_x_dqn_tpu.replay.sequence import (
+    SequenceBuilder, sequence_item_spec, stack_items)
+
+
+# ---------------------------------------------------------------------------
+# sum-tree
+
+
+def test_sum_tree_invariant_root_equals_leaf_sum():
+    tree = sum_tree.init(64)
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, 64, size=40), jnp.int32)
+    pri = jnp.asarray(rng.uniform(0.1, 5.0, size=40), jnp.float32)
+    tree = sum_tree.update(tree, idx, pri)
+    leaves = sum_tree.leaves(tree)
+    np.testing.assert_allclose(sum_tree.total(tree), leaves.sum(), rtol=1e-5)
+    # every internal node equals the sum of its children
+    t = np.asarray(tree)
+    for node in range(1, 64):
+        np.testing.assert_allclose(t[node], t[2 * node] + t[2 * node + 1],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_sum_tree_duplicate_indices_in_batch():
+    """Duplicate leaf updates in one batch must not corrupt ancestors
+    (recompute-based update: last write wins, sums stay exact)."""
+    tree = sum_tree.init(8)
+    idx = jnp.array([3, 3, 5], jnp.int32)
+    pri = jnp.array([1.0, 2.0, 4.0])
+    tree = sum_tree.update(tree, idx, pri)
+    leaves = np.asarray(sum_tree.leaves(tree))
+    assert leaves[3] == 2.0 and leaves[5] == 4.0  # last write wins
+    np.testing.assert_allclose(sum_tree.total(tree), 6.0)
+
+
+def test_sum_tree_sampling_proportional():
+    """Chi-squared check: sampling frequency tracks priority mass
+    (SURVEY.md §4 'sampling proportional to priority')."""
+    cap = 16
+    tree = sum_tree.init(cap)
+    pri = jnp.asarray(np.arange(1, cap + 1), jnp.float32)  # p_i = i+1
+    tree = sum_tree.update(tree, jnp.arange(cap, dtype=jnp.int32), pri)
+    n_draws, batch = 200, 256
+    counts = np.zeros(cap)
+    for d in range(n_draws):
+        leaf, probs = sum_tree.sample_jit(tree, jax.random.key(d), batch)
+        counts += np.bincount(np.asarray(leaf), minlength=cap)
+    total_draws = n_draws * batch
+    expected = np.asarray(pri) / float(np.asarray(pri).sum()) * total_draws
+    chi2 = ((counts - expected) ** 2 / expected).sum()
+    # df = 15; p=0.001 critical value ~ 37.7. Allow generous headroom.
+    assert chi2 < 60.0, (chi2, counts, expected)
+
+
+def test_sum_tree_sample_returns_probs():
+    tree = sum_tree.init(4)
+    tree = sum_tree.update(tree, jnp.array([0, 1], jnp.int32),
+                           jnp.array([1.0, 3.0]))
+    leaf, probs = sum_tree.sample(tree, jax.random.key(0), 128)
+    assert set(np.asarray(leaf).tolist()) <= {0, 1}  # zero-mass never drawn
+    mask0 = np.asarray(leaf) == 0
+    np.testing.assert_allclose(np.asarray(probs)[mask0], 0.25, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(probs)[~mask0], 0.75, rtol=1e-5)
+
+
+def test_sum_tree_bad_capacity():
+    with pytest.raises(AssertionError):
+        sum_tree.init(48)
+
+
+# ---------------------------------------------------------------------------
+# prioritized replay
+
+
+def _spec():
+    return {"obs": jax.ShapeDtypeStruct((3,), jnp.float32),
+            "act": jax.ShapeDtypeStruct((), jnp.int32)}
+
+
+def _items(start: int, b: int):
+    return {"obs": jnp.arange(start, start + b, dtype=jnp.float32
+                              )[:, None].repeat(3, 1),
+            "act": jnp.arange(start, start + b, dtype=jnp.int32)}
+
+
+def test_replay_add_sample_roundtrip():
+    rp = PrioritizedReplay(capacity=16, alpha=1.0, beta=0.5)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 4), jnp.array([1.0, 1.0, 1.0, 1.0]))
+    assert int(state.size) == 4 and int(state.pos) == 4
+    items, idx, w = rp.sample(state, jax.random.key(0), 32)
+    # only filled slots are ever sampled (empty leaves have zero mass)
+    assert np.asarray(idx).max() < 4
+    # sampled item contents match what was stored at that index
+    np.testing.assert_allclose(np.asarray(items["act"]), np.asarray(idx))
+    assert w.shape == (32,) and float(w.max()) == 1.0
+
+
+def test_replay_fifo_overwrite():
+    rp = PrioritizedReplay(capacity=4, alpha=1.0)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 4), jnp.ones(4))
+    state = rp.add(state, _items(100, 2), jnp.ones(2))  # wraps: slots 0,1
+    assert int(state.size) == 4 and int(state.pos) == 2
+    acts = np.asarray(state.storage["act"])
+    np.testing.assert_array_equal(acts, [100, 101, 2, 3])
+
+
+def test_replay_priority_update_shifts_sampling():
+    rp = PrioritizedReplay(capacity=8, alpha=1.0, eps=0.0)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 8), jnp.ones(8))
+    state = rp.update_priorities(
+        state, jnp.arange(8, dtype=jnp.int32),
+        jnp.array([0.0, 0.0, 0.0, 100.0, 0.0, 0.0, 0.0, 0.0]))
+    items, idx, w = rp.sample(state, jax.random.key(1), 64)
+    assert (np.asarray(idx) == 3).all()  # all mass on slot 3
+
+
+def test_replay_is_weights_formula():
+    rp = PrioritizedReplay(capacity=4, alpha=1.0, beta=1.0, eps=0.0)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 4), jnp.array([1.0, 1.0, 1.0, 5.0]))
+    items, idx, w = rp.sample(state, jax.random.key(2), 256)
+    # P = [1/8,1/8,1/8,5/8], N=4 -> w_raw = 1/(N*P) = [2,2,2,0.4]
+    # normalized by batch max (2) -> [1,1,1,0.2]
+    idx = np.asarray(idx)
+    w = np.asarray(w)
+    np.testing.assert_allclose(w[idx < 3], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(w[idx == 3], 0.2, rtol=1e-5)
+
+
+def test_replay_add_jit_and_donation():
+    rp = PrioritizedReplay(capacity=8)
+    state = rp.init(_spec())
+    state = rp.add_jit(state, _items(0, 2), jnp.ones(2))
+    state = rp.update_priorities_jit(state, jnp.array([0], jnp.int32),
+                                     jnp.array([2.0]))
+    items, idx, w = rp.sample_jit(state, jax.random.key(0), 4)
+    assert int(state.size) == 2
+
+
+def test_uniform_replay_device():
+    rp = UniformReplayDevice(capacity=8)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 3))
+    items, idx, w = rp.sample(state, jax.random.key(0), 16)
+    assert np.asarray(idx).max() < 3
+    np.testing.assert_allclose(np.asarray(w), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# sequence replay
+
+
+def test_sequence_builder_overlap():
+    sb = SequenceBuilder(seq_len=4, overlap=2, lstm_size=2)
+    state = (np.zeros(2), np.zeros(2))
+    out = []
+    for t in range(8):
+        pre = (np.full(2, float(t)), np.full(2, float(t)))
+        out += sb.append(np.array([t]), t, float(t), False, pre)
+    # emits at t=3 (steps 0-3), t=5 (steps 2-5), t=7 (steps 4-7): overlap 2
+    assert len(out) == 3
+    np.testing.assert_array_equal(out[0]["actions"], [0, 1, 2, 3])
+    np.testing.assert_array_equal(out[1]["actions"], [2, 3, 4, 5])
+    np.testing.assert_array_equal(out[2]["actions"], [4, 5, 6, 7])
+    # stored init state is the pre-state of the first step of each seq
+    np.testing.assert_allclose(out[0]["init_c"], 0.0)
+    np.testing.assert_allclose(out[1]["init_c"], 2.0)
+    np.testing.assert_allclose(out[0]["mask"], 1.0)
+
+
+def test_sequence_builder_terminal_pads():
+    sb = SequenceBuilder(seq_len=4, overlap=0, lstm_size=2)
+    pre = (np.zeros(2), np.zeros(2))
+    out = []
+    out += sb.append(np.array([0]), 0, 1.0, False, pre)
+    out += sb.append(np.array([1]), 1, 1.0, True, pre)  # terminal early
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0]["mask"], [1, 1, 0, 0])
+    np.testing.assert_array_equal(out[0]["terminals"], [0, 1, 0, 0])
+    assert sb._steps == []
+
+
+def test_sequence_builder_no_duplicate_tail_flush():
+    """Terminal exactly at a sequence boundary must not re-emit the
+    retained overlap as a bogus padded sequence."""
+    sb = SequenceBuilder(seq_len=4, overlap=2, lstm_size=2)
+    pre = (np.zeros(2), np.zeros(2))
+    out = []
+    for t in range(4):
+        out += sb.append(np.array([t]), t, 0.0, t == 3, pre)
+    assert len(out) == 1  # the full sequence only, no overlap-only flush
+
+
+def test_sequence_items_roundtrip_device():
+    sb = SequenceBuilder(seq_len=4, overlap=0, lstm_size=3)
+    pre = (np.ones(3), np.ones(3))
+    items = []
+    for t in range(8):
+        items += sb.append(np.full((2,), t, np.uint8), t, 1.0, False, pre)
+    assert len(items) == 2
+    spec = sequence_item_spec((2,), np.uint8, 4, 3)
+    rp = PrioritizedReplay(capacity=8)
+    state = rp.init(spec)
+    batch = {k: jnp.asarray(v) for k, v in stack_items(items).items()}
+    state = rp.add(state, batch, jnp.ones(2))
+    got, idx, w = rp.sample(state, jax.random.key(0), 4)
+    assert got["obs"].shape == (4, 4, 2) and got["obs"].dtype == jnp.uint8
+    assert got["init_c"].shape == (4, 3)
