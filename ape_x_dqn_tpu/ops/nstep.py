@@ -21,6 +21,8 @@ class NStepTransition:
     reward: float        # accumulated discounted n-step return
     next_obs: np.ndarray
     discount: float      # gamma^k * (1 - terminal), k = actual steps spanned
+    aux: object = None   # caller payload from the FIRST step of the window
+                         # (actors stash q_t(a_t) here for initial priorities)
 
 
 class NStepBuilder:
@@ -31,15 +33,15 @@ class NStepBuilder:
         self._window: deque = deque()
 
     def append(self, obs, action, reward: float, next_obs,
-               terminal: bool, truncated: bool = False
-               ) -> list[NStepTransition]:
+               terminal: bool, truncated: bool = False,
+               aux=None) -> list[NStepTransition]:
         """Add one env step; returns 0+ completed n-step transitions.
 
         `terminal` is a bootstrapping-relevant episode end (discount -> 0);
         `truncated` ends the episode without zeroing the bootstrap
         (time-limit: flush with discount gamma^k).
         """
-        self._window.append((obs, action, float(reward)))
+        self._window.append((obs, action, float(reward), aux))
         out: list[NStepTransition] = []
         if terminal or truncated:
             # flush the whole window through the episode end — including a
@@ -55,13 +57,13 @@ class NStepBuilder:
 
     def _emit(self, next_obs, bootstrap: float) -> NStepTransition:
         ret = 0.0
-        for k, (_, _, r) in enumerate(self._window):
+        for k, (_, _, r, _) in enumerate(self._window):
             ret += (self.gamma**k) * r
         k_span = len(self._window)
-        obs0, action0, _ = self._window[0]
+        obs0, action0, _, aux0 = self._window[0]
         return NStepTransition(
             obs=obs0, action=action0, reward=ret, next_obs=next_obs,
-            discount=(self.gamma**k_span) * bootstrap)
+            discount=(self.gamma**k_span) * bootstrap, aux=aux0)
 
     def reset(self) -> None:
         self._window.clear()

@@ -1,0 +1,148 @@
+"""Batched TPU inference server for actor policy evaluation.
+
+The reference evaluates policies per-actor on GPUs (SURVEY.md §2.3 item
+4); here many actors RPC observations to one server thread that pads
+them into fixed-size buckets and runs a single jitted forward on the
+TPU, then scatters results back (BASELINE.json north_star: "actor policy
+evaluation is batched onto a TPU inference server").
+
+Dynamic batching: the server collects requests until `max_batch` are
+waiting or the oldest has waited `deadline_ms` (latency/throughput
+trade-off, SURVEY.md §7 hard part 3). Batches are padded to the next
+power of two so XLA compiles a handful of bucket shapes once.
+
+Generic over the request pytree: a request is (inputs_pytree,) and the
+reply is outputs_pytree — plain Q-nets send obs and get Q-values;
+recurrent nets send (obs, (c, h)) and get (q, (c', h')).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+class _Request:
+    __slots__ = ("inputs", "event", "result")
+
+    def __init__(self, inputs: Any):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.result: Any = None
+
+
+class BatchedInferenceServer:
+    def __init__(self, apply_fn: Callable, params: Any,
+                 max_batch: int = 64, deadline_ms: float = 2.0):
+        """apply_fn(params, batched_inputs_pytree) -> batched outputs."""
+        self._apply = jax.jit(apply_fn)
+        self._params = params
+        self._params_version = 0
+        self._max_batch = max_batch
+        self._deadline_s = deadline_ms / 1000.0
+        self._q: queue.Queue[_Request] = queue.Queue()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._batches_served = 0
+        self._items_served = 0
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="inference-server", daemon=True)
+        self._thread.start()
+
+    # -- client side -------------------------------------------------------
+
+    def query(self, inputs: Any, timeout: float = 30.0) -> Any:
+        """Blocking single-item query. inputs: pytree WITHOUT batch dim."""
+        req = _Request(inputs)
+        self._q.put(req)
+        if not req.event.wait(timeout):
+            raise TimeoutError("inference server did not reply")
+        if isinstance(req.result, Exception):
+            raise req.result
+        return req.result
+
+    # -- learner side ------------------------------------------------------
+
+    def update_params(self, params: Any, version: int) -> None:
+        with self._lock:
+            self._params = params
+            self._params_version = version
+
+    @property
+    def params_version(self) -> int:
+        with self._lock:
+            return self._params_version
+
+    @property
+    def stats(self) -> dict:
+        return {"batches": self._batches_served,
+                "items": self._items_served,
+                "avg_batch": (self._items_served
+                              / max(self._batches_served, 1))}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+    # -- server loop -------------------------------------------------------
+
+    def _collect(self) -> list[_Request]:
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        reqs = [first]
+        deadline = time.monotonic() + self._deadline_s
+        while len(reqs) < self._max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                reqs.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return reqs
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            reqs = self._collect()
+            if not reqs:
+                continue
+            try:
+                self._serve_batch(reqs)
+            except Exception as e:  # propagate to callers, keep serving
+                for r in reqs:
+                    r.result = e
+                    r.event.set()
+
+    def _serve_batch(self, reqs: list[_Request]) -> None:
+        n = len(reqs)
+        padded = _next_pow2(max(n, 1))
+        stacked = jax.tree.map(
+            lambda *xs: _pad_stack(xs, padded), *[r.inputs for r in reqs])
+        with self._lock:
+            params = self._params
+        out = self._apply(params, stacked)
+        out_np = jax.tree.map(np.asarray, out)
+        for i, r in enumerate(reqs):
+            r.result = jax.tree.map(lambda x: x[i], out_np)
+            r.event.set()
+        self._batches_served += 1
+        self._items_served += n
+
+
+def _pad_stack(xs: tuple, padded: int) -> np.ndarray:
+    arr = np.stack([np.asarray(x) for x in xs])
+    if arr.shape[0] < padded:
+        pad_width = [(0, padded - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+        arr = np.pad(arr, pad_width)
+    return arr

@@ -1,0 +1,131 @@
+"""Actor runtime (SURVEY.md §2.2 "Actor runtime", §3.1 Actor_i loop).
+
+Each actor steps CPU envs with an eps_i-greedy policy — Horgan et al.
+2018: eps_i = base ** (1 + alpha * i / (N-1)) — getting Q-values from
+the batched TPU inference server, accumulates n-step returns, computes
+INITIAL priorities actor-side (so fresh experience enters the sum-tree
+with real TD magnitudes, not a max-priority hack), and ships transition
+batches through the transport.
+
+Initial priority bookkeeping: a transition emitted at step t needs
+max_a Q(s_{t+n}); the actor has Q(s_t..) from action selection, and
+Q(s_{t+n}) arrives at the *next* server query — so non-terminal
+transitions park in a one-step pending list. Terminal transitions
+(discount 0) and truncation flushes resolve immediately (the latter via
+one extra server query on the terminal observation).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
+
+
+def actor_epsilon(i: int, n: int, base: float = 0.4,
+                  alpha: float = 7.0) -> float:
+    if n <= 1:
+        return base
+    return base ** (1.0 + alpha * i / (n - 1))
+
+
+class Actor:
+    def __init__(self, cfg: RunConfig, actor_index: int,
+                 query_fn: Callable[[np.ndarray], np.ndarray],
+                 transport, seed: int | None = None,
+                 episode_callback: Callable[[int, dict], None] | None = None):
+        """query_fn(obs) -> q-values [A] (the inference server's .query)."""
+        self.cfg = cfg
+        self.index = actor_index
+        self.query = query_fn
+        self.transport = transport
+        self.eps = actor_epsilon(actor_index, cfg.actors.num_actors,
+                                 cfg.actors.base_eps, cfg.actors.eps_alpha)
+        seed = cfg.seed if seed is None else seed
+        self.env = make_env(cfg.env, seed=seed * 10_007 + actor_index,
+                            actor_index=actor_index)
+        self.rng = np.random.default_rng(seed * 7919 + actor_index)
+        self.nstep = NStepBuilder(cfg.learner.n_step, cfg.learner.gamma)
+        self.episode_callback = episode_callback
+        self.frames = 0
+        self._outbox: list[tuple[NStepTransition, float]] = []
+        self._pending: list[NStepTransition] = []
+
+    # -- priority resolution ----------------------------------------------
+
+    def _resolve_pending(self, q_next: np.ndarray) -> None:
+        for t in self._pending:
+            target = t.reward + t.discount * float(np.max(q_next))
+            self._outbox.append((t, abs(target - float(t.aux))))
+        self._pending.clear()
+
+    def _route(self, transitions: list[NStepTransition],
+               terminal_obs: np.ndarray | None) -> None:
+        v_term: float | None = None
+        for t in transitions:
+            if t.discount == 0.0:
+                self._outbox.append((t, abs(t.reward - float(t.aux))))
+            elif terminal_obs is not None:
+                # truncation flush: the bootstrap obs won't be queried
+                # again, ask the server once for its value
+                if v_term is None:
+                    v_term = float(np.max(self.query(terminal_obs)))
+                target = t.reward + t.discount * v_term
+                self._outbox.append((t, abs(target - float(t.aux))))
+            else:
+                self._pending.append(t)
+
+    def _ship(self, force: bool = False) -> None:
+        if not self._outbox:
+            return
+        if not force and len(self._outbox) < self.cfg.actors.ingest_batch:
+            return
+        ts = [t for t, _ in self._outbox]
+        pris = np.asarray([p for _, p in self._outbox], np.float32)
+        batch = {
+            "obs": np.stack([t.obs for t in ts]),
+            "action": np.asarray([t.action for t in ts], np.int32),
+            "reward": np.asarray([t.reward for t in ts], np.float32),
+            "next_obs": np.stack([t.next_obs for t in ts]),
+            "discount": np.asarray([t.discount for t in ts], np.float32),
+            "priorities": pris,
+            "actor": self.index,
+        }
+        self._outbox = []
+        self.transport.send_experience(batch)
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self, max_frames: int,
+            stop_event: threading.Event | None = None) -> int:
+        obs = self.env.reset()
+        while self.frames < max_frames and not (
+                stop_event is not None and stop_event.is_set()):
+            q = self.query(obs)
+            self._resolve_pending(q)
+            if self.rng.random() < self.eps:
+                action = int(self.rng.integers(self.env.spec.num_actions))
+            else:
+                action = int(np.argmax(q))
+            next_obs, reward, done, info = self.env.step(action)
+            self.frames += 1
+            terminal = info.get("terminal", done)
+            truncated = done and not terminal
+            new_ts = self.nstep.append(obs, action, reward, next_obs,
+                                       terminal, truncated,
+                                       aux=float(q[action]))
+            self._route(new_ts, terminal_obs=next_obs if truncated else None)
+            if done:
+                obs = self.env.reset()
+                if self.episode_callback and "episode_return" in info:
+                    self.episode_callback(self.index, info)
+            else:
+                obs = next_obs
+            self._ship()
+        self._ship(force=True)
+        return self.frames

@@ -1,0 +1,191 @@
+"""Ape-X orchestration: actors + inference server + ingest + learner.
+
+The reference spawns replay/learner/actor *processes* glued by gRPC
+(SURVEY.md §3.1); here the single-host runtime uses threads around the
+device-resident replay — the TPU does all heavy work (batched inference,
+the fused learner jit), so Python threads only shuttle numpy batches and
+are not a bottleneck; the process/host boundary lives behind the
+Transport interface (comm/), which multi-host deployments swap for the
+socket transport over DCN.
+
+Threads:
+- N actor threads: env stepping + priority bookkeeping (runtime/actor.py)
+- 1 ingest thread: transport -> learner.add (device ring + sum-tree)
+- 1 learner thread: train_step loop + periodic param publication
+- eval worker (runtime/evaluation.py) runs greedy episodes on demand
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.comm.transport import LoopbackTransport
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
+from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+from ape_x_dqn_tpu.runtime.actor import Actor
+from ape_x_dqn_tpu.runtime.learner import DQNLearner, transition_item_spec
+from ape_x_dqn_tpu.runtime.single_process import build_replay
+from ape_x_dqn_tpu.utils.metrics import Metrics, Throughput
+from ape_x_dqn_tpu.utils.rng import component_key
+
+
+class ApexDriver:
+    def __init__(self, cfg: RunConfig, metrics: Metrics | None = None):
+        self.cfg = cfg
+        self.metrics = metrics or Metrics()
+        probe_env = make_env(cfg.env, seed=cfg.seed)
+        self.spec = probe_env.spec
+        self.net = build_network(cfg.network, self.spec)
+        obs0 = probe_env.reset()
+        params = self.net.init(component_key(cfg.seed, "net_init"),
+                               obs0[None])
+
+        self.replay = build_replay(cfg.replay)
+        self.learner = DQNLearner(self.net.apply, self.replay, cfg.learner)
+        self.state = self.learner.init(
+            params,
+            self.replay.init(transition_item_spec(self.spec.obs_shape,
+                                                  self.spec.obs_dtype)),
+            component_key(cfg.seed, "learner"))
+
+        self.server = BatchedInferenceServer(
+            lambda p, obs: self.net.apply(p, obs),
+            params, max_batch=cfg.inference.max_batch,
+            deadline_ms=cfg.inference.deadline_ms)
+        self.transport = LoopbackTransport()
+        self.stop_event = threading.Event()
+        self.episode_returns: deque[float] = deque(maxlen=200)
+        self.frames = Throughput(window_s=30.0)
+        self.grad_steps = Throughput(window_s=30.0)
+        self._frames_total = 0
+        self._grad_steps_total = 0
+        self._lock = threading.Lock()
+        self._state_lock = threading.Lock()
+
+    # -- components --------------------------------------------------------
+
+    def _on_episode(self, actor_index: int, info: dict) -> None:
+        with self._lock:
+            self.episode_returns.append(float(info["episode_return"]))
+
+    def _actor_thread(self, i: int, max_frames: int) -> None:
+        actor = Actor(self.cfg, i, self.server.query, self.transport,
+                      episode_callback=self._on_episode)
+        actor.run(max_frames, self.stop_event)  # frames counted at ingest
+
+    def _ingest_loop(self) -> None:
+        while not self.stop_event.is_set():
+            batch = self.transport.recv_experience(timeout=0.1)
+            if batch is None:
+                continue
+            pris = jnp.asarray(batch["priorities"])
+            items = {
+                "obs": jnp.asarray(batch["obs"]),
+                "action": jnp.asarray(batch["action"]),
+                "reward": jnp.asarray(batch["reward"]),
+                "next_obs": jnp.asarray(batch["next_obs"]),
+                "discount": jnp.asarray(batch["discount"]),
+            }
+            with self._state_lock:
+                self.state = self.learner.add(self.state, items, pris)
+            n = int(pris.shape[0])
+            self.frames.add(n)
+            with self._lock:
+                self._frames_total += n
+
+    def _learner_loop(self, max_grad_steps: int) -> None:
+        publish_every = self.cfg.learner.publish_every
+        while (not self.stop_event.is_set()
+               and self._grad_steps_total < max_grad_steps):
+            with self._state_lock:
+                size = int(self.state.replay.size)
+            if size < min(self.cfg.replay.min_fill,
+                          self.replay.capacity // 2):
+                time.sleep(0.05)
+                continue
+            with self._state_lock:
+                self.state, m = self.learner.train_step(self.state)
+            self._grad_steps_total += 1
+            self.grad_steps.add(1)
+            if self._grad_steps_total % publish_every == 0:
+                self.server.update_params(self.state.params,
+                                          self._grad_steps_total)
+            if self._grad_steps_total % 100 == 0:
+                with self._lock:
+                    avg_ret = (float(np.mean(self.episode_returns))
+                               if self.episode_returns else 0.0)
+                self.metrics.log(
+                    self._grad_steps_total,
+                    loss=float(m["loss"]), q_mean=float(m["q_mean"]),
+                    frames=self._frames_total,
+                    frames_per_s=self.frames.rate(),
+                    grad_steps_per_s=self.grad_steps.rate(),
+                    avg_return=avg_ret,
+                    replay_size=int(self.state.replay.size),
+                    ingest_dropped=self.transport.dropped)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, total_env_frames: int | None = None,
+            max_grad_steps: int = 10**9,
+            wall_clock_limit_s: float | None = None) -> dict:
+        total = total_env_frames or self.cfg.total_env_frames
+        per_actor = total // max(self.cfg.actors.num_actors, 1)
+        threads = [
+            threading.Thread(target=self._actor_thread, args=(i, per_actor),
+                             name=f"actor-{i}", daemon=True)
+            for i in range(self.cfg.actors.num_actors)
+        ]
+        ingest = threading.Thread(target=self._ingest_loop, name="ingest",
+                                  daemon=True)
+        learner = threading.Thread(target=self._learner_loop,
+                                   args=(max_grad_steps,), name="learner",
+                                   daemon=True)
+        t0 = time.monotonic()
+        ingest.start()
+        learner.start()
+        for t in threads:
+            t.start()
+        try:
+            while True:
+                if (wall_clock_limit_s is not None
+                        and time.monotonic() - t0 > wall_clock_limit_s):
+                    break
+                if self._grad_steps_total >= max_grad_steps:
+                    break
+                if not any(t.is_alive() for t in threads):
+                    # actors finished: drain pending experience, then (if a
+                    # finite grad-step target was set) let the learner
+                    # reach it before shutting down
+                    if self.transport.pending == 0 and (
+                            max_grad_steps >= 10**9):
+                        break
+                time.sleep(0.2)
+        finally:
+            self.stop_event.set()
+            for t in threads:
+                t.join(timeout=5)
+            learner.join(timeout=10)
+            ingest.join(timeout=5)
+            self.server.stop()
+        with self._lock:
+            avg_ret = (float(np.mean(self.episode_returns))
+                       if self.episode_returns else 0.0)
+        return {
+            "frames": self._frames_total,
+            "grad_steps": self._grad_steps_total,
+            "avg_return": avg_ret,
+            "episodes": len(self.episode_returns),
+            "wall_s": time.monotonic() - t0,
+            "server": self.server.stats,
+            "ingest_dropped": self.transport.dropped,
+        }
