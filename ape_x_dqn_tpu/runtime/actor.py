@@ -127,5 +127,13 @@ class Actor:
             else:
                 obs = next_obs
             self._ship()
+        # resolve parked transitions (waiting on Q(s_{t+n}), which would
+        # have arrived at the next action query) with one final forward so
+        # they aren't dropped at shutdown
+        if self._pending:
+            try:
+                self._resolve_pending(self.query(obs))
+            except Exception:
+                self._pending.clear()  # server already down: drop, don't die
         self._ship(force=True)
         return self.frames

@@ -57,9 +57,14 @@ class ApexDriver:
                                                   self.spec.obs_dtype)),
             component_key(cfg.seed, "learner"))
 
+        # The learner jits donate the TrainState (learner.py train_step/add,
+        # donate_argnums=1), which deletes the donated param buffers — the
+        # server must own an independent copy or its first forward after an
+        # ingest raises "Array has been deleted" on TPU.
         self.server = BatchedInferenceServer(
             lambda p, obs: self.net.apply(p, obs),
-            params, max_batch=cfg.inference.max_batch,
+            jax.tree.map(jnp.copy, params),
+            max_batch=cfg.inference.max_batch,
             deadline_ms=cfg.inference.deadline_ms)
         self.transport = LoopbackTransport()
         self.stop_event = threading.Event()
@@ -70,6 +75,9 @@ class ApexDriver:
         self._grad_steps_total = 0
         self._lock = threading.Lock()
         self._state_lock = threading.Lock()
+        self.actor_errors: list[tuple[int, Exception]] = []
+        self.loop_errors: list[tuple[str, Exception]] = []  # ingest/learner
+        self._ingested_batches = 0
 
     # -- components --------------------------------------------------------
 
@@ -78,11 +86,25 @@ class ApexDriver:
             self.episode_returns.append(float(info["episode_return"]))
 
     def _actor_thread(self, i: int, max_frames: int) -> None:
-        actor = Actor(self.cfg, i, self.server.query, self.transport,
-                      episode_callback=self._on_episode)
-        actor.run(max_frames, self.stop_event)  # frames counted at ingest
+        try:
+            actor = Actor(self.cfg, i, self.server.query, self.transport,
+                          episode_callback=self._on_episode)
+            actor.run(max_frames, self.stop_event)  # frames counted at ingest
+        except Exception as e:
+            with self._lock:
+                self.actor_errors.append((i, e))
+
+    def _min_fill(self) -> int:
+        return min(self.cfg.replay.min_fill, self.replay.capacity // 2)
 
     def _ingest_loop(self) -> None:
+        try:
+            self._ingest_loop_inner()
+        except Exception as e:
+            with self._lock:
+                self.loop_errors.append(("ingest", e))
+
+    def _ingest_loop_inner(self) -> None:
         while not self.stop_event.is_set():
             batch = self.transport.recv_experience(timeout=0.1)
             if batch is None:
@@ -101,15 +123,22 @@ class ApexDriver:
             self.frames.add(n)
             with self._lock:
                 self._frames_total += n
+                self._ingested_batches += 1
 
     def _learner_loop(self, max_grad_steps: int) -> None:
+        try:
+            self._learner_loop_inner(max_grad_steps)
+        except Exception as e:
+            with self._lock:
+                self.loop_errors.append(("learner", e))
+
+    def _learner_loop_inner(self, max_grad_steps: int) -> None:
         publish_every = self.cfg.learner.publish_every
         while (not self.stop_event.is_set()
                and self._grad_steps_total < max_grad_steps):
             with self._state_lock:
                 size = int(self.state.replay.size)
-            if size < min(self.cfg.replay.min_fill,
-                          self.replay.capacity // 2):
+            if size < self._min_fill():
                 time.sleep(0.05)
                 continue
             with self._state_lock:
@@ -117,8 +146,11 @@ class ApexDriver:
             self._grad_steps_total += 1
             self.grad_steps.add(1)
             if self._grad_steps_total % publish_every == 0:
-                self.server.update_params(self.state.params,
-                                          self._grad_steps_total)
+                # copy under the state lock: a concurrent add() would donate
+                # the very buffers being handed to the server
+                with self._state_lock:
+                    pub = jax.tree.map(jnp.copy, self.state.params)
+                self.server.update_params(pub, self._grad_steps_total)
             if self._grad_steps_total % 100 == 0:
                 with self._lock:
                     avg_ret = (float(np.mean(self.episode_returns))
@@ -156,19 +188,35 @@ class ApexDriver:
         for t in threads:
             t.start()
         try:
+            prev_stuck_at = -1  # _ingested_batches at last stuck sighting
             while True:
                 if (wall_clock_limit_s is not None
                         and time.monotonic() - t0 > wall_clock_limit_s):
                     break
                 if self._grad_steps_total >= max_grad_steps:
                     break
+                if not (learner.is_alive() and ingest.is_alive()):
+                    break  # crashed loop: error recorded in loop_errors
                 if not any(t.is_alive() for t in threads):
-                    # actors finished: drain pending experience, then (if a
-                    # finite grad-step target was set) let the learner
-                    # reach it before shutting down
-                    if self.transport.pending == 0 and (
-                            max_grad_steps >= 10**9):
-                        break
+                    # actors finished: drain pending experience, then let
+                    # the learner reach a finite grad-step target — UNLESS
+                    # it can never make progress (replay stuck below
+                    # min_fill with nothing left to ingest), in which case
+                    # spinning forever helps nobody
+                    if self.transport.pending == 0:
+                        with self._state_lock:
+                            size = int(self.state.replay.size)
+                        with self._lock:
+                            ingested = self._ingested_batches
+                        stuck = size < self._min_fill()
+                        if max_grad_steps >= 10**9:
+                            break
+                        # require stuck on two consecutive polls with no
+                        # ingest in between: the final batch may be
+                        # mid-add (popped from the queue, add not done)
+                        if stuck and ingested == prev_stuck_at:
+                            break
+                        prev_stuck_at = ingested if stuck else -1
                 time.sleep(0.2)
         finally:
             self.stop_event.set()
@@ -188,4 +236,6 @@ class ApexDriver:
             "wall_s": time.monotonic() - t0,
             "server": self.server.stats,
             "ingest_dropped": self.transport.dropped,
+            "actor_errors": list(self.actor_errors),
+            "loop_errors": list(self.loop_errors),
         }

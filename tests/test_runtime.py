@@ -131,9 +131,26 @@ def test_apex_driver_end_to_end():
     driver = ApexDriver(cfg)
     out = driver.run(total_env_frames=1200, max_grad_steps=50,
                      wall_clock_limit_s=120)
+    # no actor may die mid-run (round-1 verdict: a use-after-donate crash
+    # killed an actor and this test still passed)
+    assert out["actor_errors"] == [], out["actor_errors"]
     assert out["frames"] > 300, out
     assert out["grad_steps"] >= 50, out
     assert out["episodes"] > 0
     assert out["server"]["items"] > 0
     # params were published to the inference server at least once
     assert driver.server.params_version > 0
+
+
+def test_apex_driver_shuts_down_when_learner_cannot_progress():
+    """Actors finish before replay reaches min_fill + finite grad-step
+    target: run() must return instead of spinning forever."""
+    cfg = _tiny_cfg(num_actors=1).replace(
+        replay=ReplayConfig(kind="prioritized", capacity=2048,
+                            min_fill=2000))
+    driver = ApexDriver(cfg)
+    out = driver.run(total_env_frames=100, max_grad_steps=50,
+                     wall_clock_limit_s=60)
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert out["grad_steps"] == 0
+    assert out["wall_s"] < 50  # returned well before the wall-clock limit
