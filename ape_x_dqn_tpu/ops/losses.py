@@ -112,25 +112,34 @@ def nstep_targets_in_sequence(rewards: jax.Array, terminals: jax.Array,
     b, length = rewards.shape
     if rescale:
         bootstrap = value_rescale.h_inv(bootstrap)
+    t_idx = jnp.arange(length)[None, :]
     ret = jnp.zeros((b, length))
     disc = jnp.ones((b, length))
     alive = jnp.ones((b, length))
-    # static unroll over n (n is 3-5): R_n[t] = sum_k gamma^k r[t+k] * alive
+    # static unroll over n (n is 3-5): R_n[t] = sum_k gamma^k r[t+k] * alive.
+    # jnp.roll wraps, so every rolled quantity is masked to real in-range
+    # data — wrapped rewards/terminals from the sequence head must never
+    # leak into windows hanging off the tail.
     for k in range(n_step):
-        r_k = jnp.roll(rewards, -k, axis=1)
-        ret = ret + disc * alive * r_k
-        d_k = jnp.roll(terminals, -k, axis=1)
-        alive = alive * (1.0 - d_k)
+        m_k = (jnp.roll(mask, -k, axis=1)
+               * (t_idx + k < length).astype(jnp.float32))
+        ret = ret + disc * alive * jnp.roll(rewards, -k, axis=1) * m_k
+        alive = alive * (1.0 - jnp.roll(terminals, -k, axis=1) * m_k)
         disc = disc * gamma
     boot_n = jnp.roll(bootstrap, -n_step, axis=1)
     target = ret + disc * alive * boot_n
     if rescale:
         target = value_rescale.h(target)
-    # valid iff t + n_step < L, the step itself is real data, AND the
-    # bootstrap position is real data (never bootstrap from padding)
-    t_idx = jnp.arange(length)[None, :]
+    # A position trains iff it is real data AND its target is fully
+    # determined: either the bootstrap at t+n is real in-range data, or a
+    # terminal inside [t, t+n) zeroed the bootstrap (alive == 0) and the
+    # return is grounded — without the latter the last n transitions of
+    # every episode (including the terminal-reward step) would never be
+    # trained on while still serving as bootstrap values for earlier steps.
     mask_boot = jnp.roll(mask, -n_step, axis=1)
-    valid = (t_idx < length - n_step).astype(jnp.float32) * mask * mask_boot
+    boot_ok = (t_idx < length - n_step).astype(jnp.float32) * mask_boot
+    terminated = 1.0 - alive
+    valid = mask * jnp.clip(boot_ok + terminated, 0.0, 1.0)
     return target, valid
 
 

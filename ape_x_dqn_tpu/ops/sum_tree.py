@@ -60,14 +60,22 @@ def update(tree: jax.Array, leaf_idx: jax.Array,
     return tree
 
 
-def sample(tree: jax.Array, rng: jax.Array, batch: int
-           ) -> tuple[jax.Array, jax.Array]:
+def sample(tree: jax.Array, rng: jax.Array, batch: int,
+           size: jax.Array | None = None) -> tuple[jax.Array, jax.Array]:
     """Stratified proportional sampling.
 
     Returns (leaf_idx [batch] int32, probs [batch] f32) where probs are
     normalized leaf probabilities p_i / total. Stratification: sample i
     draws uniformly from the i-th of `batch` equal slices of the total
     mass (variance reduction, as in standard PER implementations).
+
+    `size` (int32, number of live leaves) clamps the descent's landing
+    spot into the filled region: float32 rounding in the stratified u or
+    the accumulated left-child sums can walk the descent one leaf past
+    the live mass onto a zero-priority slot, and an all-zero tree would
+    deterministically return the rightmost leaf. Probs are re-gathered
+    after clamping so IS weights always describe the leaf actually
+    returned.
     """
     cap = capacity_of(tree)
     depth = cap.bit_length() - 1
@@ -81,7 +89,9 @@ def sample(tree: jax.Array, rng: jax.Array, batch: int
         u = jnp.where(go_right, u - left, u)
         idx = 2 * idx + go_right.astype(jnp.int32)
     leaf = idx - cap
-    probs = tree[idx] / jnp.maximum(tot, 1e-12)
+    if size is not None:
+        leaf = jnp.minimum(leaf, jnp.maximum(size, 1) - 1)
+    probs = tree[cap + leaf] / jnp.maximum(tot, 1e-12)
     return leaf, probs
 
 

@@ -109,7 +109,12 @@ class DistDQNLearner:
 
         # per-shard stratified sampling from per-shard trees (no ICI)
         def shard_sample(rstate: ReplayState, key):
-            idx, probs = sum_tree.sample(rstate.tree, key, self.b_local)
+            # size clamps the descent into the filled region — a shard's
+            # tree can be sparsely filled (or empty early under uneven
+            # round-robin ingest) and a zero-priority leaf would otherwise
+            # dominate the batch through its huge IS weight
+            idx, probs = sum_tree.sample(rstate.tree, key, self.b_local,
+                                         size=rstate.size)
             items = jax.tree.map(lambda buf: buf[idx], rstate.storage)
             return items, idx, probs
 

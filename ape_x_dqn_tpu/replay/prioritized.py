@@ -79,7 +79,8 @@ class PrioritizedReplay:
     def sample(self, state: ReplayState, rng: jax.Array, batch: int
                ) -> tuple[Any, jax.Array, jax.Array]:
         """-> (item batch pytree, leaf indices [B], IS weights [B])."""
-        idx, probs = sum_tree.sample(state.tree, rng, batch)
+        idx, probs = sum_tree.sample(state.tree, rng, batch,
+                                     size=state.size)
         items = jax.tree.map(lambda buf: buf[idx], state.storage)
         n = jnp.maximum(state.size.astype(jnp.float32), 1.0)
         w = (n * jnp.maximum(probs, 1e-12)) ** (-self.beta)

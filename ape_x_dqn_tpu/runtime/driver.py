@@ -32,6 +32,7 @@ from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
 from ape_x_dqn_tpu.runtime.actor import Actor
+from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
 from ape_x_dqn_tpu.runtime.learner import DQNLearner, transition_item_spec
 from ape_x_dqn_tpu.runtime.single_process import build_replay
 from ape_x_dqn_tpu.utils.metrics import Metrics, Throughput
@@ -78,6 +79,7 @@ class ApexDriver:
         self.actor_errors: list[tuple[int, Exception]] = []
         self.loop_errors: list[tuple[str, Exception]] = []  # ingest/learner
         self._ingested_batches = 0
+        self.last_eval: dict | None = None
 
     # -- components --------------------------------------------------------
 
@@ -165,6 +167,30 @@ class ApexDriver:
                     replay_size=int(self.state.replay.size),
                     ingest_dropped=self.transport.dropped)
 
+    def _eval_loop(self) -> None:
+        """Greedy-eval at every eval_every_steps grad-step boundary
+        (SURVEY.md §2.2 'Eval worker'); shares the inference server."""
+        try:
+            every = self.cfg.eval_every_steps
+            worker = EvalWorker(self.cfg, self.server.query)
+            next_at = every
+            while not self.stop_event.wait(0.2):
+                if self._grad_steps_total < next_at:
+                    continue
+                res = worker.run(self.cfg.eval_episodes,
+                                 stop_event=self.stop_event)
+                if res is None:  # cancelled mid-eval at shutdown
+                    break
+                with self._lock:
+                    self.last_eval = res
+                self.metrics.log(self._grad_steps_total,
+                                 avg_eval_return=res["mean_return"],
+                                 eval_episodes=res["episodes"])
+                next_at = (self._grad_steps_total // every + 1) * every
+        except Exception as e:
+            with self._lock:
+                self.loop_errors.append(("eval", e))
+
     # -- run ---------------------------------------------------------------
 
     def run(self, total_env_frames: int | None = None,
@@ -182,9 +208,14 @@ class ApexDriver:
         learner = threading.Thread(target=self._learner_loop,
                                    args=(max_grad_steps,), name="learner",
                                    daemon=True)
+        evaluator = (threading.Thread(target=self._eval_loop, name="eval",
+                                      daemon=True)
+                     if self.cfg.eval_every_steps > 0 else None)
         t0 = time.monotonic()
         ingest.start()
         learner.start()
+        if evaluator is not None:
+            evaluator.start()
         for t in threads:
             t.start()
         try:
@@ -224,6 +255,24 @@ class ApexDriver:
                 t.join(timeout=5)
             learner.join(timeout=10)
             ingest.join(timeout=5)
+            if evaluator is not None:
+                evaluator.join(timeout=10)
+            # end-of-training eval: short runs can finish inside one eval
+            # poll interval, so guarantee at least one greedy evaluation
+            # while the inference server is still up
+            if (evaluator is not None and self.last_eval is None
+                    and self._grad_steps_total > 0
+                    and not self.loop_errors):
+                try:
+                    res = EvalWorker(self.cfg, self.server.query).run(
+                        self.cfg.eval_episodes)
+                    if res is not None:
+                        self.last_eval = res
+                        self.metrics.log(self._grad_steps_total,
+                                         avg_eval_return=res["mean_return"],
+                                         eval_episodes=res["episodes"])
+                except Exception as e:
+                    self.loop_errors.append(("final_eval", e))
             self.server.stop()
         with self._lock:
             avg_ret = (float(np.mean(self.episode_returns))
@@ -238,4 +287,5 @@ class ApexDriver:
             "ingest_dropped": self.transport.dropped,
             "actor_errors": list(self.actor_errors),
             "loop_errors": list(self.loop_errors),
+            "eval": self.last_eval,
         }

@@ -1,0 +1,112 @@
+"""Eval worker: greedy-policy evaluation episodes and the HNS suite.
+
+Reference parity (SURVEY.md §2.2 "Eval worker", §5 metrics): a periodic
+evaluator running near-greedy (eps = 0.001) episodes whose *unclipped*
+returns feed the Atari-57 median human-normalized score — the north-star
+metric (BASELINE.json `metric`). Evaluation shares the batched TPU
+inference server with the actors (one more client on the same jit), so no
+separate device or params copy is needed.
+
+Eval episodes differ from training episodes in the standard ways: no
+episodic-life pseudo-terminals, no reward clipping, near-greedy policy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable
+
+import numpy as np
+
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.utils.metrics import ATARI_HUMAN_RANDOM, median_hns
+
+
+class EvalWorker:
+    """Runs greedy eval episodes against a Q-value query function."""
+
+    def __init__(self, cfg: RunConfig, query_fn: Callable,
+                 game: str | None = None, seed: int | None = None):
+        """query_fn(obs) -> q-values [A] (e.g. inference server .query)."""
+        self.cfg = cfg
+        env_cfg = cfg.env
+        if game is not None:
+            env_cfg = dataclasses.replace(env_cfg, id=game)
+        if env_cfg.kind in ("atari", "synthetic_atari"):
+            env_cfg = dataclasses.replace(env_cfg, episodic_life=False,
+                                          clip_rewards=False)
+        seed = (cfg.seed + 977_231) if seed is None else seed
+        self.env = make_env(env_cfg, seed=seed)
+        self.query = query_fn
+        self.eps = cfg.eval_eps
+        self.rng = np.random.default_rng(seed)
+
+    def run_episode(self, max_frames: int = 108_000,
+                    stop_event=None) -> float | None:
+        """One episode; returns the unclipped episode return, or None if
+        stop_event fired mid-episode (the partial return is meaningless)."""
+        obs = self.env.reset()
+        ep_return = 0.0
+        for _ in range(max_frames):
+            if stop_event is not None and stop_event.is_set():
+                return None
+            if self.rng.random() < self.eps:
+                action = int(self.rng.integers(self.env.spec.num_actions))
+            else:
+                action = int(np.argmax(self.query(obs)))
+            obs, reward, done, info = self.env.step(action)
+            ep_return += info.get("raw_reward", reward)
+            if done:
+                # prefer the env's own unclipped accounting when present
+                return float(info.get("episode_return", ep_return))
+        return ep_return
+
+    def run(self, episodes: int, max_frames: int = 108_000,
+            stop_event=None) -> dict | None:
+        """Aggregate stats over episodes; None if cancelled before any
+        episode completed."""
+        returns = []
+        for _ in range(episodes):
+            r = self.run_episode(max_frames, stop_event=stop_event)
+            if r is None:
+                break
+            returns.append(r)
+        if not returns:
+            return None
+        return {
+            "episodes": len(returns),
+            "mean_return": float(np.mean(returns)),
+            "median_return": float(np.median(returns)),
+            "min_return": float(np.min(returns)),
+            "max_return": float(np.max(returns)),
+        }
+
+
+ATARI57_GAMES: tuple[str, ...] = tuple(sorted(ATARI_HUMAN_RANDOM))
+
+
+def evaluate_suite(cfg: RunConfig, query_fn: Callable,
+                   games: Iterable[str] | None = None,
+                   episodes_per_game: int | None = None,
+                   max_frames: int = 108_000) -> dict:
+    """Per-game greedy scores -> median human-normalized score.
+
+    The Atari-57 harness (SURVEY.md §2.1 config 3): loops the suite,
+    evaluates each game with the shared query_fn, and aggregates the
+    north-star `median_hns`. Returns {"scores": {game: mean}, "hns":
+    {game: hns}, "median_hns": float}.
+    """
+    games = tuple(games) if games is not None else ATARI57_GAMES
+    episodes = episodes_per_game or cfg.eval_episodes
+    scores: dict[str, float] = {}
+    for game in games:
+        worker = EvalWorker(cfg, query_fn, game=game)
+        scores[game] = worker.run(episodes, max_frames)["mean_return"]
+    known = {g: s for g, s in scores.items() if g in ATARI_HUMAN_RANDOM}
+    from ape_x_dqn_tpu.utils.metrics import human_normalized_score
+    return {
+        "scores": scores,
+        "hns": {g: human_normalized_score(g, s) for g, s in known.items()},
+        "median_hns": median_hns(known),
+    }

@@ -1,0 +1,148 @@
+"""Benchmark: Ape-X learner throughput on the flagship Atari config.
+
+Measures the north-star number (BASELINE.md "Driver-set target"): learner
+grad-steps/s at batch 512 on the dueling Nature-CNN (84x84x4 uint8), with
+the prioritized sum-tree replay resident in HBM and the entire
+sample->loss->optimize->priority-writeback cycle fused in one XLA jit
+(`DQNLearner.train_many`, a lax.scan over K steps per dispatch).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": "learner_grad_steps_per_s", "value": N, "unit": "steps/s",
+   "vs_baseline": N / 19.0}
+vs_baseline is relative to the reference's published learner throughput
+(~19 grad-updates/s @ batch 512 on one GPU, Horgan et al. 2018 — see
+BASELINE.md); the driver-set target is >=2.0x.
+
+Secondary numbers (samples/s, inference forwards/s, compile/ingest times)
+go to stderr so the stdout contract stays parseable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_learner(capacity: int, batch_size: int):
+    from ape_x_dqn_tpu.configs import LearnerConfig, NetworkConfig
+    from ape_x_dqn_tpu.envs.base import EnvSpec
+    from ape_x_dqn_tpu.models import build_network
+    from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+    from ape_x_dqn_tpu.runtime.learner import (DQNLearner,
+                                               transition_item_spec)
+    from ape_x_dqn_tpu.utils.rng import component_key
+
+    spec = EnvSpec(obs_shape=(84, 84, 4), obs_dtype=np.dtype(np.uint8),
+                   discrete=True, num_actions=18)
+    net = build_network(NetworkConfig(kind="nature_cnn", dueling=True), spec)
+    params = net.init(component_key(0, "net_init"),
+                      jnp.zeros((1, 84, 84, 4), jnp.uint8))
+    lcfg = LearnerConfig(batch_size=batch_size)
+    replay = PrioritizedReplay(capacity=capacity)
+    learner = DQNLearner(net.apply, replay, lcfg)
+    state = learner.init(
+        params, replay.init(transition_item_spec(spec.obs_shape,
+                                                 spec.obs_dtype)),
+        component_key(0, "learner"))
+    return net, learner, state, spec
+
+
+def prefill(learner, state, spec, n_items: int, chunk: int = 4096):
+    """Fill replay with synthetic transitions via the real `add` jit."""
+    rng = np.random.default_rng(0)
+    t0 = time.monotonic()
+    for _ in range(n_items // chunk):
+        items = {
+            "obs": jnp.asarray(
+                rng.integers(0, 255, (chunk, *spec.obs_shape)), jnp.uint8),
+            "action": jnp.asarray(
+                rng.integers(0, spec.num_actions, chunk), jnp.int32),
+            "reward": jnp.asarray(rng.normal(size=chunk), jnp.float32),
+            "next_obs": jnp.asarray(
+                rng.integers(0, 255, (chunk, *spec.obs_shape)), jnp.uint8),
+            "discount": jnp.full(chunk, 0.99**3, jnp.float32),
+        }
+        pris = jnp.asarray(rng.uniform(0.1, 2.0, chunk), jnp.float32)
+        state = learner.add(state, items, pris)
+    jax.block_until_ready(state.replay.tree)
+    dt = time.monotonic() - t0
+    log(f"prefill: {n_items} transitions in {dt:.1f}s "
+        f"({n_items / dt:,.0f} items/s ingest)")
+    return state
+
+
+def bench_learner(learner, state, steps_per_dispatch: int,
+                  dispatches: int) -> tuple[float, object]:
+    # compile + warmup dispatch (excluded from timing)
+    t0 = time.monotonic()
+    state, m = learner.train_many(state, steps_per_dispatch)
+    jax.block_until_ready(m["loss"])
+    log(f"train_many compile+first dispatch: {time.monotonic() - t0:.1f}s "
+        f"(loss={float(m['loss']):.4f})")
+    t0 = time.monotonic()
+    for _ in range(dispatches):
+        state, m = learner.train_many(state, steps_per_dispatch)
+    jax.block_until_ready(m["loss"])
+    dt = time.monotonic() - t0
+    assert np.isfinite(float(m["loss"])), "non-finite loss in steady state"
+    return (steps_per_dispatch * dispatches) / dt, state
+
+
+def bench_inference(net, spec, batch: int = 64, iters: int = 50) -> float:
+    """Forwards/s of the inference-server jit at its typical bucket size."""
+    params = net.init(jax.random.key(0), jnp.zeros((1, *spec.obs_shape),
+                                                   jnp.uint8))
+    fwd = jax.jit(net.apply)
+    obs = jnp.zeros((batch, *spec.obs_shape), jnp.uint8)
+    jax.block_until_ready(fwd(params, obs))
+    t0 = time.monotonic()
+    for _ in range(iters):
+        out = fwd(params, obs)
+    jax.block_until_ready(out)
+    return batch * iters / (time.monotonic() - t0)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--capacity", type=int, default=1 << 16,
+                   help="replay capacity (stacked-frame storage: "
+                   "~56KB HBM per transition)")
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--prefill", type=int, default=1 << 15)
+    p.add_argument("--steps-per-dispatch", type=int, default=50)
+    p.add_argument("--dispatches", type=int, default=10)
+    args = p.parse_args()
+
+    log(f"devices: {jax.devices()}")
+    net, learner, state, spec = build_learner(args.capacity, args.batch_size)
+    state = prefill(learner, state, spec, args.prefill)
+
+    gsps, state = bench_learner(learner, state, args.steps_per_dispatch,
+                                args.dispatches)
+    log(f"learner: {gsps:.1f} grad-steps/s @ batch {args.batch_size} "
+        f"= {gsps * args.batch_size:,.0f} samples/s "
+        f"(capacity {args.capacity})")
+    fps = bench_inference(net, spec)
+    log(f"inference: {fps:,.0f} forwards/s @ bucket 64")
+
+    baseline = 19.0  # Horgan et al. 2018: 1-GPU learner, batch 512
+    print(json.dumps({
+        "metric": "learner_grad_steps_per_s",
+        "value": round(gsps, 2),
+        "unit": "steps/s",
+        "vs_baseline": round(gsps / baseline, 2),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Eval worker + HNS suite harness (SURVEY.md §2.2 'Eval worker';
+BASELINE.json metric: Atari-57 median human-normalized score)."""
+
+import numpy as np
+
+from ape_x_dqn_tpu.configs import (
+    ActorConfig, EnvConfig, InferenceConfig, LearnerConfig, ReplayConfig,
+    get_config)
+from ape_x_dqn_tpu.runtime.driver import ApexDriver
+from ape_x_dqn_tpu.runtime.evaluation import (
+    ATARI57_GAMES, EvalWorker, evaluate_suite)
+from ape_x_dqn_tpu.utils.metrics import median_hns
+
+
+def test_eval_worker_cartpole_greedy_episode():
+    cfg = get_config("cartpole_smoke")
+
+    def query_fn(obs):
+        # push-left policy: obs[2] is pole angle; fall fast but legally
+        return np.array([1.0, 0.0], np.float32)
+
+    worker = EvalWorker(cfg, query_fn)
+    res = worker.run(episodes=3, max_frames=600)
+    assert res["episodes"] == 3
+    assert 1.0 <= res["mean_return"] <= 500.0
+    assert res["min_return"] <= res["median_return"] <= res["max_return"]
+
+
+def test_eval_worker_atari_uses_unclipped_returns():
+    """Eval env must disable reward clipping and episodic-life: returns
+    are raw game scores, possibly outside [-1, 1] per step."""
+    cfg = get_config("pong").replace(
+        env=EnvConfig(id="pong", kind="synthetic_atari"))
+
+    def query_fn(obs):
+        return np.zeros(6, np.float32)  # NOOP policy
+
+    worker = EvalWorker(cfg, query_fn)
+    assert worker.env._clip is False
+    assert worker.env._episodic_life is False
+    ret = worker.run_episode(max_frames=2000)
+    assert np.isfinite(ret)
+
+
+def test_evaluate_suite_median_hns():
+    cfg = get_config("pong").replace(
+        env=EnvConfig(id="pong", kind="synthetic_atari"),
+        eval_episodes=1)
+
+    def query_fn(obs):
+        return np.zeros(6, np.float32)
+
+    out = evaluate_suite(cfg, query_fn, games=("pong", "breakout"),
+                         episodes_per_game=1, max_frames=500)
+    assert set(out["scores"]) == {"pong", "breakout"}
+    assert set(out["hns"]) == {"pong", "breakout"}
+    expect = median_hns({g: out["scores"][g] for g in out["scores"]})
+    assert abs(out["median_hns"] - expect) < 1e-9
+
+
+def test_atari57_suite_is_57_games():
+    assert len(ATARI57_GAMES) == 57
+
+
+def test_driver_emits_eval_metrics():
+    cfg = get_config("cartpole_smoke").replace(
+        actors=ActorConfig(num_actors=2, base_eps=0.6, ingest_batch=16),
+        replay=ReplayConfig(kind="prioritized", capacity=2048, min_fill=64),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        eval_every_steps=20, eval_episodes=2)
+    driver = ApexDriver(cfg)
+    out = driver.run(total_env_frames=1500, max_grad_steps=60,
+                     wall_clock_limit_s=120)
+    assert out["actor_errors"] == [] and out["loop_errors"] == [], out
+    assert out["eval"] is not None, "eval never ran"
+    # a shutdown can cancel eval mid-run; at least one episode completed
+    assert 1 <= out["eval"]["episodes"] <= 2
+    latest = driver.metrics.latest()
+    assert "avg_eval_return" in latest

@@ -212,3 +212,54 @@ def test_sequence_targets_never_bootstrap_from_padding():
         rewards, terminals, boot, mask, n_step=1, gamma=0.9, rescale=False)
     # t=2 would bootstrap from padded t=3 -> must be invalid
     np.testing.assert_allclose(valid[0], [1.0, 1.0, 0.0, 0.0])
+
+
+def test_nstep_targets_terminal_window_valid_at_sequence_end():
+    """A terminal inside [t, t+n) fully determines the target even when
+    t+n hangs off the sequence end — the last n transitions of every
+    episode (including the terminal-reward step) must be trained on."""
+    gamma = 0.5
+    rewards = jnp.array([[1.0, 2.0, 4.0, 8.0]])
+    terminals = jnp.array([[0.0, 0.0, 0.0, 1.0]])
+    boot = jnp.full((1, 4), 100.0)
+    mask = jnp.ones((1, 4))
+    target, valid = nstep_targets_in_sequence(
+        rewards, terminals, boot, mask, n_step=2, gamma=gamma, rescale=False)
+    # t=2: 4 + 0.5*8, terminal at t=3 kills the bootstrap -> grounded
+    # t=3: window [3,5) off the end BUT terminal at t=3 -> target = 8
+    np.testing.assert_allclose(target[0, 2:], [8.0, 8.0], rtol=1e-6)
+    np.testing.assert_allclose(valid[0], [1, 1, 1, 1])
+
+
+def test_nstep_targets_terminal_then_padding():
+    """Typical terminal-flushed sequence: padding after the terminal.
+    Steps whose window reaches into padding stay valid iff grounded."""
+    gamma = 1.0
+    rewards = jnp.array([[1.0, 2.0, 4.0, 0.0]])
+    terminals = jnp.array([[0.0, 0.0, 1.0, 0.0]])
+    mask = jnp.array([[1.0, 1.0, 1.0, 0.0]])
+    boot = jnp.full((1, 4), 100.0)
+    target, valid = nstep_targets_in_sequence(
+        rewards, terminals, boot, mask, n_step=2, gamma=gamma, rescale=False)
+    # t=0: 1 + 2 + boot[2] = 103 (bootstrap real, in range)
+    # t=1: 2 + 4, terminal at t=2 -> grounded (boot position 3 is padding)
+    # t=2: 4, terminal at t=2 -> grounded
+    # t=3: padding -> invalid
+    np.testing.assert_allclose(target[0, :3], [103.0, 6.0, 4.0], rtol=1e-6)
+    np.testing.assert_allclose(valid[0], [1, 1, 1, 0])
+
+
+def test_nstep_targets_no_wraparound_leak():
+    """jnp.roll wraps; a terminal at t=0 must not leak into windows
+    hanging off the tail (which would mark them spuriously valid)."""
+    gamma = 1.0
+    rewards = jnp.array([[1.0, 2.0, 4.0, 8.0]])
+    terminals = jnp.array([[1.0, 0.0, 0.0, 0.0]])
+    boot = jnp.zeros((1, 4))  # zero bootstrap isolates the reward sums
+    mask = jnp.ones((1, 4))
+    target, valid = nstep_targets_in_sequence(
+        rewards, terminals, boot, mask, n_step=2, gamma=gamma, rescale=False)
+    # t=2 and t=3: no terminal in window, bootstrap off the end -> invalid
+    np.testing.assert_allclose(valid[0], [1, 1, 0, 0])
+    # and the wrapped reward r[0] must not appear in t=3's return
+    np.testing.assert_allclose(target[0, 3], 8.0, rtol=1e-6)

@@ -217,3 +217,33 @@ def test_sequence_items_roundtrip_device():
     got, idx, w = rp.sample(state, jax.random.key(0), 4)
     assert got["obs"].shape == (4, 4, 2) and got["obs"].dtype == jnp.uint8
     assert got["init_c"].shape == (4, 3)
+
+
+def test_sum_tree_sample_clamps_to_filled_region():
+    """Descent must never land on zero-priority/unfilled leaves: float32
+    rounding can push it one leaf past the live mass."""
+    tree = sum_tree.init(8)
+    tree = sum_tree.update(tree, jnp.arange(3, dtype=jnp.int32),
+                           jnp.array([1.0, 1.0, 1.0]))
+    leaf, probs = sum_tree.sample(tree, jax.random.key(0), 64,
+                                  size=jnp.int32(3))
+    assert int(leaf.max()) < 3 and int(leaf.min()) >= 0
+    assert (np.asarray(probs) > 0).all()
+
+
+def test_sum_tree_sample_empty_tree_guarded():
+    """An all-zero tree must not return the rightmost (garbage) leaf."""
+    tree = sum_tree.init(8)
+    leaf, _ = sum_tree.sample(tree, jax.random.key(0), 4, size=jnp.int32(0))
+    assert (np.asarray(leaf) == 0).all()
+
+
+def test_replay_sample_partially_filled_never_returns_unfilled():
+    rp = PrioritizedReplay(capacity=64)
+    st = rp.init({"x": jax.ShapeDtypeStruct((), jnp.float32)})
+    st = rp.add(st, {"x": jnp.arange(5, dtype=jnp.float32)},
+                jnp.ones(5) * 0.001)  # tiny priorities stress rounding
+    for seed in range(5):
+        _, idx, w = rp.sample(st, jax.random.key(seed), 32)
+        assert int(idx.max()) < 5
+        assert (np.asarray(w) > 0).all()
