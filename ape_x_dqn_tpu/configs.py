@@ -76,6 +76,9 @@ class LearnerConfig:
     double_dqn: bool = True
     value_rescale: bool = False  # R2D2 h(x) transform
     publish_every: int = 50  # learner→actor weight publish cadence (steps)
+    # grad-steps fused into one train_many dispatch in the driver hot loop
+    # (lax.scan on device; no host round-trips between steps)
+    train_chunk: int = 8
     # DPG
     critic_lr: float = 1e-3
     policy_lr: float = 1e-4

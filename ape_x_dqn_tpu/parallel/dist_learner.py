@@ -196,5 +196,12 @@ class DistDQNLearner:
         The tp all-gather happens over ICI (XLA resharding), mirroring
         the reference's learner->actor weight broadcast (SURVEY.md §2.3
         item 3), without interrupting train_many dispatches.
+
+        The jnp.copy is load-bearing: device_put ALIASES leaves whose
+        sharding is already replicated (small biases), and the learner
+        jits donate the TrainState — an aliased publication would hand
+        the inference server buffers that the next add/train_step
+        deletes.
         """
-        return jax.device_put(state.params, self._repl_sharding)
+        repl = jax.device_put(state.params, self._repl_sharding)
+        return jax.tree.map(jnp.copy, repl)

@@ -26,9 +26,7 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
-
-def _next_pow2(n: int) -> int:
-    return 1 << (n - 1).bit_length()
+from ape_x_dqn_tpu.utils.misc import next_pow2
 
 
 class _Request:
@@ -126,7 +124,7 @@ class BatchedInferenceServer:
 
     def _serve_batch(self, reqs: list[_Request]) -> None:
         n = len(reqs)
-        padded = _next_pow2(max(n, 1))
+        padded = next_pow2(max(n, 1))
         stacked = jax.tree.map(
             lambda *xs: _pad_stack(xs, padded), *[r.inputs for r in reqs])
         with self._lock:

@@ -14,6 +14,7 @@ episodic-life pseudo-terminals, no reward clipping, near-greedy policy.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Iterable
 
 import numpy as np
@@ -43,13 +44,17 @@ class EvalWorker:
         self.rng = np.random.default_rng(seed)
 
     def run_episode(self, max_frames: int = 108_000,
-                    stop_event=None) -> float | None:
+                    stop_event=None,
+                    deadline: float | None = None) -> float | None:
         """One episode; returns the unclipped episode return, or None if
-        stop_event fired mid-episode (the partial return is meaningless)."""
+        stop_event fired / the wall-clock deadline passed mid-episode
+        (the partial return is meaningless)."""
         obs = self.env.reset()
         ep_return = 0.0
         for _ in range(max_frames):
             if stop_event is not None and stop_event.is_set():
+                return None
+            if deadline is not None and time.monotonic() > deadline:
                 return None
             if self.rng.random() < self.eps:
                 action = int(self.rng.integers(self.env.spec.num_actions))
@@ -63,12 +68,17 @@ class EvalWorker:
         return ep_return
 
     def run(self, episodes: int, max_frames: int = 108_000,
-            stop_event=None) -> dict | None:
+            stop_event=None, deadline_s: float | None = None) -> dict | None:
         """Aggregate stats over episodes; None if cancelled before any
-        episode completed."""
+        episode completed. deadline_s bounds the whole evaluation's
+        wall-clock (needed at shutdown, where an unbounded greedy policy
+        could otherwise block the driver for minutes)."""
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
         returns = []
         for _ in range(episodes):
-            r = self.run_episode(max_frames, stop_event=stop_event)
+            r = self.run_episode(max_frames, stop_event=stop_event,
+                                 deadline=deadline)
             if r is None:
                 break
             returns.append(r)

@@ -27,15 +27,12 @@ from ape_x_dqn_tpu.replay.prioritized import (
 from ape_x_dqn_tpu.runtime.learner import (
     DQNLearner, transition_item_spec)
 from ape_x_dqn_tpu.utils.metrics import Metrics
+from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import RngStream, component_key
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << (n - 1).bit_length()
-
-
 def build_replay(rcfg):
-    cap = _next_pow2(rcfg.capacity)
+    cap = next_pow2(rcfg.capacity)
     if rcfg.kind == "uniform":
         return UniformReplayDevice(capacity=cap)
     return PrioritizedReplay(capacity=cap, alpha=rcfg.alpha, beta=rcfg.beta,

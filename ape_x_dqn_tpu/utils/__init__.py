@@ -1,2 +1,3 @@
 from ape_x_dqn_tpu.utils.rng import RngStream, split_key
 from ape_x_dqn_tpu.utils.metrics import Metrics, Throughput
+from ape_x_dqn_tpu.utils.misc import next_pow2
