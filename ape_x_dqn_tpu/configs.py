@@ -20,7 +20,7 @@ from typing import Any
 @dataclass(frozen=True)
 class EnvConfig:
     id: str = "CartPole-v1"
-    kind: str = "cartpole"  # cartpole | atari | control | synthetic_atari
+    kind: str = "cartpole"  # cartpole | cartpole_po | atari | control | synthetic_atari
     # Atari preprocessing (SURVEY.md §2.2 "Env wrappers")
     frame_skip: int = 4
     frame_stack: int = 4

@@ -59,6 +59,8 @@ def make_env(cfg, seed: int = 0, actor_index: int = 0) -> Env:
     kind = cfg.kind
     if kind == "cartpole":
         return cartpole.CartPole(seed=seed)
+    if kind == "cartpole_po":
+        return cartpole.MaskedCartPole(seed=seed)
     if kind in ("atari", "synthetic_atari"):
         return atari.make_atari(cfg, seed=seed, actor_index=actor_index)
     if kind == "control":

@@ -76,3 +76,27 @@ class CartPole(Env):
             info["episode_return"] = self._ep_return
             info["episode_length"] = self._steps
         return self._state.copy(), reward, done, info
+
+
+class MaskedCartPole(CartPole):
+    """Partially observable CartPole: velocities are hidden.
+
+    obs = [x, theta] only — the classic POMDP variant where a memoryless
+    policy cannot infer x_dot/theta_dot, so improving return requires the
+    recurrent state. This is the R2D2 runtime's end-to-end correctness
+    task (SURVEY.md §2.1 config 4 stand-in for this image, like synthetic
+    catch stands in for ALE).
+    """
+
+    spec = EnvSpec(obs_shape=(2,), obs_dtype=np.dtype(np.float32),
+                   discrete=True, num_actions=2)
+
+    def _mask(self, obs: np.ndarray) -> np.ndarray:
+        return obs[[0, 2]]
+
+    def reset(self) -> np.ndarray:
+        return self._mask(super().reset())
+
+    def step(self, action):
+        obs, reward, done, info = super().step(action)
+        return self._mask(obs), reward, done, info

@@ -35,28 +35,45 @@ def sequence_item_spec(obs_shape: tuple[int, ...], obs_dtype,
 
 
 class SequenceBuilder:
-    """Per-env accumulator emitting overlapping fixed-length sequences."""
+    """Per-env accumulator emitting overlapping fixed-length sequences.
+
+    Actors attach a per-step |TD| estimate (1-step, from the Q-values they
+    already hold for action selection), and every emitted item carries an
+    initial sequence priority under the extra key ``"priority"`` — the
+    same eta-mix of max/mean |TD| the learner writes back (SURVEY.md §2.2
+    "Actor runtime": initial priorities computed actor-side). Callers
+    strip the key before device storage via `split_priorities`.
+    """
 
     def __init__(self, seq_len: int = 80, overlap: int = 40,
-                 lstm_size: int = 512):
+                 lstm_size: int = 512, priority_eta: float = 0.9):
         assert 0 <= overlap < seq_len
         self.seq_len = seq_len
         self.overlap = overlap
         self.lstm_size = lstm_size
+        self.priority_eta = priority_eta
         self._steps: list[dict] = []  # each: obs/action/reward/terminal/pre_c/pre_h
         self._retained = 0  # leading steps already covered by a prior emit
 
     def append(self, obs, action, reward, terminal: bool,
-               pre_state: tuple[np.ndarray, np.ndarray]) -> list[dict]:
+               pre_state: tuple[np.ndarray, np.ndarray],
+               td: float = 0.0,
+               episode_end: bool | None = None) -> list[dict]:
         """Add one step; pre_state is the (c, h) fed to the net AT this step.
 
-        Returns 0+ completed sequence items (dicts matching
-        sequence_item_spec).
+        `terminal` marks a bootstrapping-relevant episode end (stored in
+        the terminals array); `episode_end` (default: terminal) flushes
+        the sequence — a time-limit truncation ends the sequence without
+        marking a terminal, since the recurrent state resets but the
+        bootstrap must survive. Returns 0+ completed sequence items
+        (dicts matching sequence_item_spec plus "priority").
         """
+        if episode_end is None:
+            episode_end = terminal
         c, h = pre_state
         self._steps.append(dict(
             obs=np.asarray(obs), action=int(action), reward=float(reward),
-            terminal=bool(terminal),
+            terminal=bool(terminal), td=abs(float(td)),
             pre_c=np.asarray(c, np.float32).reshape(-1),
             pre_h=np.asarray(h, np.float32).reshape(-1)))
         out = []
@@ -66,7 +83,7 @@ class SequenceBuilder:
             self._steps = self._steps[self.seq_len - self.overlap:] \
                 if self.overlap else []
             self._retained = len(self._steps)
-        if terminal:
+        if episode_end:
             # flush the padded partial tail, but only if it contains steps
             # not already covered by the previous emit's overlap
             if len(self._steps) > self._retained:
@@ -79,6 +96,16 @@ class SequenceBuilder:
         self._steps = []
         self._retained = 0
 
+    def flush(self) -> list[dict]:
+        """Emit the padded partial tail (actor shutdown), if it holds any
+        step not already covered by the previous emit's overlap."""
+        out = []
+        if len(self._steps) > self._retained:
+            out.append(self._emit(self._steps))
+        self._steps = []
+        self._retained = 0
+        return out
+
     def _emit(self, steps: list[dict]) -> dict:
         n = len(steps)
         assert n > 0
@@ -89,22 +116,39 @@ class SequenceBuilder:
         rewards = np.zeros(length, np.float32)
         terminals = np.zeros(length, np.float32)
         mask = np.zeros(length, np.float32)
+        tds = np.zeros(n, np.float32)
         for i, s in enumerate(steps):
             obs[i] = s["obs"]
             actions[i] = s["action"]
             rewards[i] = s["reward"]
             terminals[i] = float(s["terminal"])
             mask[i] = 1.0
+            tds[i] = s["td"]
+        eta = self.priority_eta
+        priority = eta * float(tds.max()) + (1 - eta) * float(tds.mean())
         return {
             "obs": obs, "actions": actions, "rewards": rewards,
             "terminals": terminals, "mask": mask,
             "init_c": first["pre_c"], "init_h": first["pre_h"],
+            "priority": priority,
         }
 
 
+def split_priorities(items: list[dict]) -> tuple[list[dict], np.ndarray]:
+    """Strip the builder's "priority" key -> (storage items, priorities)."""
+    pris = np.asarray([it.get("priority", 0.0) for it in items], np.float32)
+    return [{k: v for k, v in it.items() if k != "priority"}
+            for it in items], pris
+
+
 def stack_items(items: list[dict]) -> dict:
-    """Stack a list of sequence items into a batch pytree of [B, ...]."""
-    return {k: np.stack([it[k] for it in items]) for k in items[0]}
+    """Stack a list of sequence items into a batch pytree of [B, ...].
+
+    Skips the builder's scalar "priority" side-channel key, which is not
+    part of the stored item spec.
+    """
+    return {k: np.stack([it[k] for it in items])
+            for k in items[0] if k != "priority"}
 
 
 def batch_to_sequence_batch(items: Any):

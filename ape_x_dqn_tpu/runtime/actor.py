@@ -25,6 +25,8 @@ import numpy as np
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
+from ape_x_dqn_tpu.replay.sequence import (
+    SequenceBuilder, split_priorities, stack_items)
 
 
 def actor_epsilon(i: int, n: int, base: float = 0.4,
@@ -35,6 +37,14 @@ def actor_epsilon(i: int, n: int, base: float = 0.4,
 
 
 class Actor:
+    """Discrete eps_i-greedy actor; also the base for ContinuousActor.
+
+    Subclass hooks: `_select_action` (policy out -> action),
+    `_bootstrap_value` (policy out -> V(s) estimate for n-step targets),
+    `_taken_value` (policy out + action -> the value whose TD error seeds
+    the initial priority), `_action_array` (stacking dtype for shipment).
+    """
+
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[np.ndarray], np.ndarray],
                  transport, seed: int | None = None,
@@ -53,14 +63,32 @@ class Actor:
         self.nstep = NStepBuilder(cfg.learner.n_step, cfg.learner.gamma)
         self.episode_callback = episode_callback
         self.frames = 0
+        self._frames_unshipped = 0
         self._outbox: list[tuple[NStepTransition, float]] = []
         self._pending: list[NStepTransition] = []
 
+    # -- policy hooks (overridden by ContinuousActor) ----------------------
+
+    def _select_action(self, out):
+        if self.rng.random() < self.eps:
+            return int(self.rng.integers(self.env.spec.num_actions))
+        return int(np.argmax(out))
+
+    def _bootstrap_value(self, out) -> float:
+        return float(np.max(out))
+
+    def _taken_value(self, out, action) -> float:
+        return float(out[action])
+
+    def _action_array(self, ts: list[NStepTransition]) -> np.ndarray:
+        return np.asarray([t.action for t in ts], np.int32)
+
     # -- priority resolution ----------------------------------------------
 
-    def _resolve_pending(self, q_next: np.ndarray) -> None:
+    def _resolve_pending(self, out) -> None:
+        v_next = self._bootstrap_value(out)
         for t in self._pending:
-            target = t.reward + t.discount * float(np.max(q_next))
+            target = t.reward + t.discount * v_next
             self._outbox.append((t, abs(target - float(t.aux))))
         self._pending.clear()
 
@@ -74,7 +102,7 @@ class Actor:
                 # truncation flush: the bootstrap obs won't be queried
                 # again, ask the server once for its value
                 if v_term is None:
-                    v_term = float(np.max(self.query(terminal_obs)))
+                    v_term = self._bootstrap_value(self.query(terminal_obs))
                 target = t.reward + t.discount * v_term
                 self._outbox.append((t, abs(target - float(t.aux))))
             else:
@@ -89,14 +117,16 @@ class Actor:
         pris = np.asarray([p for _, p in self._outbox], np.float32)
         batch = {
             "obs": np.stack([t.obs for t in ts]),
-            "action": np.asarray([t.action for t in ts], np.int32),
+            "action": self._action_array(ts),
             "reward": np.asarray([t.reward for t in ts], np.float32),
             "next_obs": np.stack([t.next_obs for t in ts]),
             "discount": np.asarray([t.discount for t in ts], np.float32),
             "priorities": pris,
             "actor": self.index,
+            "frames": self._frames_unshipped,
         }
         self._outbox = []
+        self._frames_unshipped = 0
         self.transport.send_experience(batch)
 
     # -- main loop ---------------------------------------------------------
@@ -106,19 +136,17 @@ class Actor:
         obs = self.env.reset()
         while self.frames < max_frames and not (
                 stop_event is not None and stop_event.is_set()):
-            q = self.query(obs)
-            self._resolve_pending(q)
-            if self.rng.random() < self.eps:
-                action = int(self.rng.integers(self.env.spec.num_actions))
-            else:
-                action = int(np.argmax(q))
+            out = self.query(obs)
+            self._resolve_pending(out)
+            action = self._select_action(out)
             next_obs, reward, done, info = self.env.step(action)
             self.frames += 1
+            self._frames_unshipped += 1
             terminal = info.get("terminal", done)
             truncated = done and not terminal
             new_ts = self.nstep.append(obs, action, reward, next_obs,
                                        terminal, truncated,
-                                       aux=float(q[action]))
+                                       aux=self._taken_value(out, action))
             self._route(new_ts, terminal_obs=next_obs if truncated else None)
             if done:
                 obs = self.env.reset()
@@ -135,5 +163,173 @@ class Actor:
                 self._resolve_pending(self.query(obs))
             except Exception:
                 self._pending.clear()  # server already down: drop, don't die
+        self._ship(force=True)
+        return self.frames
+
+
+class ContinuousActor(Actor):
+    """Ape-X DPG actor: deterministic policy + Gaussian exploration noise.
+
+    Horgan et al. 2018 "Ape-X DPG" (SURVEY.md §2.1 config 5): actions are
+    mu(s) + N(0, sigma^2) clipped to the action box, with sigma from
+    ActorConfig.noise_sigma (scaled by the box half-range). The inference
+    server evaluates both the policy and the critic in one batched
+    forward — {"a": mu(s), "q": Q(s, mu(s))} — so actors compute initial
+    priorities from the critic's value estimates exactly like discrete
+    actors do from max-Q (same one-step pending mechanism).
+    """
+
+    def __init__(self, cfg: RunConfig, actor_index: int,
+                 query_fn: Callable[[np.ndarray], dict],
+                 transport, seed: int | None = None,
+                 episode_callback: Callable[[int, dict], None] | None = None):
+        super().__init__(cfg, actor_index, query_fn, transport, seed=seed,
+                         episode_callback=episode_callback)
+        self.sigma = cfg.actors.noise_sigma
+        spec = self.env.spec
+        self._noise_scale = (self.sigma
+                             * (spec.action_high - spec.action_low) / 2.0)
+
+    def _select_action(self, out):
+        spec = self.env.spec
+        noise = self.rng.normal(0.0, self._noise_scale,
+                                size=spec.action_dim)
+        return np.clip(np.asarray(out["a"], np.float32) + noise,
+                       spec.action_low,
+                       spec.action_high).astype(np.float32)
+
+    def _bootstrap_value(self, out) -> float:
+        return float(out["q"])
+
+    def _taken_value(self, out, action) -> float:
+        # Q(s, mu(s)) stands in for Q(s, a_taken): the noise perturbation
+        # is small, and this is only the initial-priority seed
+        return float(out["q"])
+
+    def _action_array(self, ts: list[NStepTransition]) -> np.ndarray:
+        return np.stack([np.asarray(t.action, np.float32) for t in ts])
+
+
+class RecurrentActor(Actor):
+    """R2D2 actor: carries LSTM state, ships stored-state sequences.
+
+    Shares Actor's construction scaffolding (epsilon schedule, env/rng
+    seeding, frame accounting) but replaces the flat n-step pipeline with
+    a SequenceBuilder and a stateful run loop.
+
+    The recurrent (c, h) rides the inference server's generic request
+    pytree (parallel/inference_server.py): each query sends
+    {"obs", "c", "h"} and gets {"q", "c", "h"} back, so the batched TPU
+    forward serves many actors' recurrent steps at once (SURVEY.md §3.2).
+
+    Initial sequence priorities are computed actor-side from 1-step TD
+    estimates (the n-step-in-sequence TD is the learner's job; the 1-step
+    |TD| eta-mix is the same fresh-experience signal at a fraction of the
+    bookkeeping). A step's TD needs max_a Q(s_{t+1}), which arrives at
+    the *next* server query — so each step parks for one iteration before
+    entering the SequenceBuilder (mirroring Actor's pending list).
+    """
+
+    def __init__(self, cfg: RunConfig, actor_index: int,
+                 query_fn: Callable[[dict], dict],
+                 transport, seed: int | None = None,
+                 episode_callback: Callable[[int, dict], None] | None = None):
+        super().__init__(cfg, actor_index, query_fn, transport, seed=seed,
+                         episode_callback=episode_callback)
+        self.gamma = cfg.learner.gamma
+        self.lstm_size = cfg.network.lstm_size
+        self.builder = SequenceBuilder(
+            seq_len=cfg.replay.seq_length, overlap=cfg.replay.seq_overlap,
+            lstm_size=self.lstm_size, priority_eta=cfg.replay.priority_eta)
+        # ingest_batch counts transitions; sequences ship in proportionally
+        # smaller groups so ingest latency stays comparable
+        self.ship_after = max(1, cfg.actors.ingest_batch
+                              // cfg.replay.seq_length)
+        self._outbox: list[dict] = []  # sequence items, not transitions
+
+    def _zero_state(self) -> tuple[np.ndarray, np.ndarray]:
+        z = np.zeros(self.lstm_size, np.float32)
+        return z, z.copy()
+
+    def _feed(self, rec: dict, td: float) -> None:
+        self._outbox.extend(self.builder.append(
+            rec["obs"], rec["action"], rec["reward"], rec["terminal"],
+            rec["pre_state"], td=td, episode_end=rec["episode_end"]))
+
+    def _ship(self, force: bool = False) -> None:
+        if not self._outbox:
+            return
+        if not force and len(self._outbox) < self.ship_after:
+            return
+        items, pris = split_priorities(self._outbox)
+        batch = stack_items(items)
+        batch["priorities"] = pris
+        batch["actor"] = self.index
+        batch["frames"] = self._frames_unshipped
+        self._outbox = []
+        self._frames_unshipped = 0
+        self.transport.send_experience(batch)
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self, max_frames: int,
+            stop_event: threading.Event | None = None) -> int:
+        obs = self.env.reset()
+        c, h = self._zero_state()
+        prev: dict | None = None  # step awaiting its 1-step TD bootstrap
+        while self.frames < max_frames and not (
+                stop_event is not None and stop_event.is_set()):
+            out = self.query({"obs": obs, "c": c, "h": h})
+            q = out["q"]
+            if prev is not None:
+                td = (prev["reward"] + self.gamma * float(np.max(q))
+                      - prev["q_sa"])
+                self._feed(prev, td)
+                prev = None
+            if self.rng.random() < self.eps:
+                action = int(self.rng.integers(self.env.spec.num_actions))
+            else:
+                action = int(np.argmax(q))
+            next_obs, reward, done, info = self.env.step(action)
+            self.frames += 1
+            self._frames_unshipped += 1
+            terminal = info.get("terminal", done)
+            rec = dict(obs=obs, action=action, reward=float(reward),
+                       terminal=terminal, pre_state=(c, h),
+                       q_sa=float(q[action]), episode_end=done)
+            if terminal:
+                # bootstrap is zero: the TD is fully determined now
+                self._feed(rec, rec["reward"] - rec["q_sa"])
+            elif done:
+                # truncation: the sequence ends (state resets) but the
+                # bootstrap survives — one extra query on the final obs
+                out2 = self.query({"obs": next_obs,
+                                   "c": out["c"], "h": out["h"]})
+                td = (reward + self.gamma * float(np.max(out2["q"]))
+                      - rec["q_sa"])
+                self._feed(rec, td)
+            else:
+                prev = rec
+            if done:
+                obs = self.env.reset()
+                c, h = self._zero_state()
+                if self.episode_callback and "episode_return" in info:
+                    self.episode_callback(self.index, info)
+            else:
+                obs = next_obs
+                c, h = out["c"], out["h"]
+            self._ship()
+        # shutdown: resolve the parked step with one final forward, flush
+        # the builder's partial tail, and ship everything
+        if prev is not None:
+            try:
+                out = self.query({"obs": obs, "c": c, "h": h})
+                td = (prev["reward"] + self.gamma * float(np.max(out["q"]))
+                      - prev["q_sa"])
+            except Exception:
+                td = prev["reward"] - prev["q_sa"]
+            prev["episode_end"] = False
+            self._feed(prev, td)
+        self._outbox.extend(self.builder.flush())
         self._ship(force=True)
         return self.frames

@@ -33,10 +33,16 @@ from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
-from ape_x_dqn_tpu.runtime.actor import Actor
+from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
+from ape_x_dqn_tpu.runtime.actor import (
+    Actor, ContinuousActor, RecurrentActor)
+from ape_x_dqn_tpu.runtime.dpg_learner import (
+    DPGLearner, continuous_item_spec)
 from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
 from ape_x_dqn_tpu.runtime.learner import DQNLearner, transition_item_spec
+from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 from ape_x_dqn_tpu.runtime.single_process import build_replay
+from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
 from ape_x_dqn_tpu.utils.metrics import Metrics, Throughput
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
@@ -50,13 +56,40 @@ class ApexDriver:
         self.spec = probe_env.spec
         self.net = build_network(cfg.network, self.spec)
         obs0 = probe_env.reset()
-        params = self.net.init(component_key(cfg.seed, "net_init"),
-                               obs0[None])
-
-        item_spec = transition_item_spec(self.spec.obs_shape,
-                                         self.spec.obs_dtype)
+        # model family: flat-transition DQN, stored-state sequences (R2D2),
+        # or continuous-control actor-critic (Ape-X DPG)
+        self.family = {"lstm_q": "r2d2", "dpg": "dpg"}.get(
+            cfg.network.kind, "dqn")
+        if self.family == "r2d2":
+            z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
+            params = self.net.init(component_key(cfg.seed, "net_init"),
+                                   obs0[None, None], (z, z))
+            item_spec = sequence_item_spec(
+                self.spec.obs_shape, self.spec.obs_dtype,
+                cfg.replay.seq_length, cfg.network.lstm_size)
+        elif self.family == "dpg":
+            actor_net, critic_net = self.net
+            a0 = jnp.zeros((1, self.spec.action_dim), jnp.float32)
+            params = (
+                actor_net.init(component_key(cfg.seed, "actor_init"),
+                               obs0[None]),
+                critic_net.init(component_key(cfg.seed, "critic_init"),
+                                obs0[None], a0))
+            item_spec = continuous_item_spec(
+                self.spec.obs_shape, self.spec.obs_dtype,
+                self.spec.action_dim)
+        else:
+            params = self.net.init(component_key(cfg.seed, "net_init"),
+                                   obs0[None])
+            item_spec = transition_item_spec(self.spec.obs_shape,
+                                             self.spec.obs_dtype)
+        self._item_keys = tuple(item_spec.keys())
         self.dp = cfg.parallel.dp
         self.is_dist = cfg.parallel.dp * cfg.parallel.tp > 1
+        if self.is_dist and self.family != "dqn":
+            raise NotImplementedError(
+                "distributed learner currently covers the DQN family; "
+                "run r2d2/dpg with parallel dp=tp=1")
         if self.is_dist:
             # Multi-chip learner (SURVEY.md §7 step 7): replay shards +
             # batch shards + gradient psum over the (dp, tp) mesh; ingest
@@ -80,20 +113,34 @@ class ApexDriver:
             server_params = self.learner.publish_params(self.state)
         else:
             self.replay = build_replay(cfg.replay)
-            self.learner = DQNLearner(self.net.apply, self.replay,
-                                      cfg.learner)
-            self.state = self.learner.init(
-                params, self.replay.init(item_spec),
-                component_key(cfg.seed, "learner"))
+            lkey = component_key(cfg.seed, "learner")
+            if self.family == "r2d2":
+                self.learner = SequenceLearner(
+                    lambda p, o, s: self.net.apply(p, o, s),
+                    self.replay, cfg.learner, cfg.replay)
+                self.state = self.learner.init(
+                    params, self.replay.init(item_spec), lkey)
+            elif self.family == "dpg":
+                actor_net, critic_net = self.net
+                self.learner = DPGLearner(
+                    actor_net.apply, critic_net.apply, self.replay,
+                    cfg.learner)
+                self.state = self.learner.init(
+                    params[0], params[1], self.replay.init(item_spec), lkey)
+            else:
+                self.learner = DQNLearner(self.net.apply, self.replay,
+                                          cfg.learner)
+                self.state = self.learner.init(
+                    params, self.replay.init(item_spec), lkey)
             self.capacity = self.replay.capacity
             # The learner jits donate the TrainState (learner.py
             # train_step/add, donate_argnums=1), which deletes the donated
             # param buffers — the server must own an independent copy or
             # its first forward after an ingest raises "Array has been
-            # deleted" on TPU.
-            server_params = jax.tree.map(jnp.copy, params)
+            # deleted" on TPU. publish_params copies.
+            server_params = self.learner.publish_params(self.state)
         self.server = BatchedInferenceServer(
-            lambda p, obs: self.net.apply(p, obs),
+            self._server_apply_fn(),
             server_params,
             max_batch=cfg.inference.max_batch,
             deadline_ms=cfg.inference.deadline_ms)
@@ -120,8 +167,112 @@ class ApexDriver:
         self._stage_chunk = max(cfg.actors.ingest_batch, 1)
         self._stage_dropped = 0
         self.last_eval: dict | None = None
+        # checkpoint/resume (SURVEY.md §5): params/targets/opt/rng/step are
+        # saved; replay contents are not (large, and Ape-X tolerates
+        # refilling it — the actors regenerate experience on resume)
+        self.ckpt = (CheckpointManager(cfg.checkpoint_dir)
+                     if cfg.checkpoint_dir else None)
+        if self.ckpt is not None:
+            self._maybe_restore()
+
+    # -- checkpoint / resume ----------------------------------------------
+
+    @staticmethod
+    def _dev_copy(x):
+        # typed PRNG keys can't cross to numpy directly; store key data
+        if jnp.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return jnp.copy(jax.random.key_data(x))
+        return jnp.copy(x)
+
+    def _ckpt_payload(self) -> dict:
+        """Host copy of the train state minus replay, donation-safe.
+
+        Only a fast on-device jnp.copy happens under the state lock (an
+        aliased buffer would be deleted by the next donating train/add
+        jit); the device->host transfer for the Orbax write runs outside
+        it so checkpointing never stalls the learner hot loop."""
+        with self._state_lock:
+            dev = {k: jax.tree.map(self._dev_copy, v)
+                   for k, v in self.state._asdict().items()
+                   if k != "replay"}
+        return {k: jax.tree.map(np.asarray, v) for k, v in dev.items()}
+
+    def _save_checkpoint(self, wait: bool = False) -> None:
+        self.ckpt.save(self._grad_steps_total, self._ckpt_payload(),
+                       wait=wait)
+
+    def _maybe_restore(self) -> None:
+        if self.ckpt.latest_step() is None:
+            return  # fresh start: skip building the (host-copy) template
+        template = self._ckpt_payload()
+        restored = self.ckpt.restore(template=template)
+        if restored is None:
+            return
+        # land each leaf back on device with the layout the learner state
+        # already has (replicated/sharded alike), then resume the counter
+        def put_leaf(x, ref):
+            if jnp.issubdtype(ref.dtype, jax.dtypes.prng_key):
+                x = jax.random.wrap_key_data(jnp.asarray(x))
+            return jax.device_put(jnp.asarray(x), ref.sharding)
+
+        with self._state_lock:
+            put = {
+                k: jax.tree.map(lambda x, ref: put_leaf(x, ref),
+                                v, getattr(self.state, k))
+                for k, v in restored.items()}
+            self.state = self.state._replace(**put)
+        self._grad_steps_total = int(np.asarray(restored["step"]))
+        self._publish_params()
 
     # -- components --------------------------------------------------------
+
+    def _server_apply_fn(self):
+        """The batched forward the inference server jits, per family."""
+        if self.family == "r2d2":
+            def apply_rec(p, inp):
+                q, (c, h) = self.net.apply(p, inp["obs"],
+                                           (inp["c"], inp["h"]),
+                                           method=self.net.step)
+                return {"q": q, "c": c, "h": h}
+            return apply_rec
+        if self.family == "dpg":
+            actor_net, critic_net = self.net
+
+            def apply_dpg(p, obs):
+                a = actor_net.apply(p["actor"], obs)
+                q = critic_net.apply(p["critic"], obs, a)
+                return {"a": a, "q": q}
+            return apply_dpg
+        return lambda p, obs: self.net.apply(p, obs)
+
+    def _make_eval_policy(self):
+        """Per-episode policy factory for the eval worker: recurrent
+        policies carry fresh (c, h) across an episode's queries;
+        continuous policies return the deterministic action mu(s)."""
+        if self.family == "dpg":
+            query = self.server.query
+            return lambda: lambda obs: query(obs)["a"]
+        if self.family != "r2d2":
+            return None  # EvalWorker defaults to the plain query_fn
+        lstm_size = self.cfg.network.lstm_size
+        query = self.server.query
+
+        def factory():
+            state = {"c": np.zeros(lstm_size, np.float32),
+                     "h": np.zeros(lstm_size, np.float32)}
+
+            def policy(obs):
+                out = query({"obs": obs, "c": state["c"], "h": state["h"]})
+                state["c"], state["h"] = out["c"], out["h"]
+                return out["q"]
+
+            return policy
+
+        return factory
+
+    def _make_eval_worker(self) -> EvalWorker:
+        return EvalWorker(self.cfg, self.server.query,
+                          policy_factory=self._make_eval_policy())
 
     def _on_episode(self, actor_index: int, info: dict) -> None:
         with self._lock:
@@ -129,8 +280,10 @@ class ApexDriver:
 
     def _actor_thread(self, i: int, max_frames: int) -> None:
         try:
-            actor = Actor(self.cfg, i, self.server.query, self.transport,
-                          episode_callback=self._on_episode)
+            actor_cls = {"r2d2": RecurrentActor,
+                         "dpg": ContinuousActor}.get(self.family, Actor)
+            actor = actor_cls(self.cfg, i, self.server.query, self.transport,
+                              episode_callback=self._on_episode)
             actor.run(max_frames, self.stop_event)  # frames counted at ingest
         except Exception as e:
             with self._lock:
@@ -146,8 +299,6 @@ class ApexDriver:
             with self._lock:
                 self.loop_errors.append(("ingest", e))
 
-    _ITEM_KEYS = ("obs", "action", "reward", "next_obs", "discount")
-
     def _ingest_loop_inner(self) -> None:
         while not self.stop_event.is_set():
             batch = self.transport.recv_experience(timeout=0.1)
@@ -161,21 +312,24 @@ class ApexDriver:
             self._flush_stage(force=True)
 
     def _ingest_one(self, batch: dict, n: int) -> None:
+        # sequence batches carry fewer items than env frames; actors ship
+        # the true frame count alongside (flat batches: frames == items)
+        frames = int(batch.get("frames", n))
         if self.is_dist:
             self._stage.append(batch)
             self._stage_n += n
             self._flush_stage()
         else:
-            items = {k: jnp.asarray(batch[k]) for k in self._ITEM_KEYS}
+            items = {k: jnp.asarray(batch[k]) for k in self._item_keys}
             pris = jnp.asarray(batch["priorities"])
             with self._state_lock:
                 self.state = self.learner.add(self.state, items, pris)
             with self._lock:
                 self._replay_filled = min(self._replay_filled + n,
                                           self.capacity)
-        self.frames.add(n)
+        self.frames.add(frames)
         with self._lock:
-            self._frames_total += n
+            self._frames_total += frames
             self._ingested_batches += 1
 
     def _flush_stage(self, force: bool = False) -> None:
@@ -187,7 +341,7 @@ class ApexDriver:
         while self._stage_n >= block:
             fields = {
                 k: np.concatenate([np.asarray(b[k]) for b in self._stage])
-                for k in self._ITEM_KEYS + ("priorities",)}
+                for k in self._item_keys + ("priorities",)}
             take = {k: v[:block] for k, v in fields.items()}
             rest = {k: v[block:] for k, v in fields.items()}
             self._stage = [rest] if rest["priorities"].shape[0] else []
@@ -223,14 +377,11 @@ class ApexDriver:
 
     def _publish_params(self) -> None:
         # copy/reshard under the state lock: a concurrent add() or
-        # train dispatch would donate the very buffers being published
+        # train dispatch would donate the very buffers being published.
+        # Dist publication is a tp all-gather + replication over ICI
+        # (SURVEY.md §2.3 item 3); single-chip learners copy.
         with self._state_lock:
-            if self.is_dist:
-                # tp all-gather + replication over ICI (SURVEY.md §2.3
-                # item 3); device_put lands fresh buffers the server owns
-                pub = self.learner.publish_params(self.state)
-            else:
-                pub = jax.tree.map(jnp.copy, self.state.params)
+            pub = self.learner.publish_params(self.state)
         self.server.update_params(pub, self._grad_steps_total)
 
     def _learner_loop_inner(self, max_grad_steps: int) -> None:
@@ -238,6 +389,7 @@ class ApexDriver:
         # a chunk larger than the publish cadence would snap to 1 forever
         chunk = max(min(self.cfg.learner.train_chunk, publish_every), 1)
         last_log = 0
+        last_ckpt = self._grad_steps_total
         while (not self.stop_event.is_set()
                and self._grad_steps_total < max_grad_steps):
             with self._lock:
@@ -262,6 +414,10 @@ class ApexDriver:
             self.grad_steps.add(k)
             if self._grad_steps_total % publish_every == 0:
                 self._publish_params()
+            if (self.ckpt is not None and self._grad_steps_total - last_ckpt
+                    >= self.cfg.checkpoint_every):
+                self._save_checkpoint()
+                last_ckpt = self._grad_steps_total
             if self._grad_steps_total - last_log >= 100:
                 last_log = self._grad_steps_total
                 with self._lock:
@@ -283,7 +439,7 @@ class ApexDriver:
         (SURVEY.md §2.2 'Eval worker'); shares the inference server."""
         try:
             every = self.cfg.eval_every_steps
-            worker = EvalWorker(self.cfg, self.server.query)
+            worker = self._make_eval_worker()
             next_at = every
             while not self.stop_event.wait(0.2):
                 if self._grad_steps_total < next_at:
@@ -368,13 +524,14 @@ class ApexDriver:
             if evaluator is not None:
                 evaluator.join(timeout=10)
             # end-of-training eval: short runs can finish inside one eval
-            # poll interval, so guarantee at least one greedy evaluation
-            # while the inference server is still up
-            if (evaluator is not None and self.last_eval is None
+            # poll interval (and eval_every_steps=0 disables the periodic
+            # thread entirely), so guarantee at least one greedy
+            # evaluation while the inference server is still up
+            if (self.cfg.eval_episodes > 0 and self.last_eval is None
                     and self._grad_steps_total > 0
                     and not self.loop_errors):
                 try:
-                    res = EvalWorker(self.cfg, self.server.query).run(
+                    res = self._make_eval_worker().run(
                         self.cfg.eval_episodes, deadline_s=60.0)
                     if res is not None:
                         self.last_eval = res
@@ -383,6 +540,12 @@ class ApexDriver:
                                          eval_episodes=res["episodes"])
                 except Exception as e:
                     self.loop_errors.append(("final_eval", e))
+            # final checkpoint so a killed run resumes where it stopped
+            if self.ckpt is not None and self._grad_steps_total > 0:
+                try:
+                    self._save_checkpoint(wait=True)
+                except Exception as e:
+                    self.loop_errors.append(("checkpoint", e))
             self.server.stop()
         with self._lock:
             avg_ret = (float(np.mean(self.episode_returns))

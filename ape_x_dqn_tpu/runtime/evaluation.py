@@ -28,8 +28,15 @@ class EvalWorker:
     """Runs greedy eval episodes against a Q-value query function."""
 
     def __init__(self, cfg: RunConfig, query_fn: Callable,
-                 game: str | None = None, seed: int | None = None):
-        """query_fn(obs) -> q-values [A] (e.g. inference server .query)."""
+                 game: str | None = None, seed: int | None = None,
+                 policy_factory: Callable[[], Callable] | None = None):
+        """query_fn(obs) -> q-values [A] (e.g. inference server .query).
+
+        policy_factory, when given, builds a fresh per-episode policy
+        (obs -> q-values for discrete envs, obs -> action for continuous)
+        — recurrent policies carry (c, h) across the episode's queries,
+        continuous ones route through the deterministic DPG actor.
+        """
         self.cfg = cfg
         env_cfg = cfg.env
         if game is not None:
@@ -40,6 +47,7 @@ class EvalWorker:
         seed = (cfg.seed + 977_231) if seed is None else seed
         self.env = make_env(env_cfg, seed=seed)
         self.query = query_fn
+        self.policy_factory = policy_factory
         self.eps = cfg.eval_eps
         self.rng = np.random.default_rng(seed)
 
@@ -49,6 +57,9 @@ class EvalWorker:
         """One episode; returns the unclipped episode return, or None if
         stop_event fired / the wall-clock deadline passed mid-episode
         (the partial return is meaningless)."""
+        policy = (self.policy_factory() if self.policy_factory is not None
+                  else self.query)
+        discrete = self.env.spec.discrete
         obs = self.env.reset()
         ep_return = 0.0
         for _ in range(max_frames):
@@ -56,10 +67,17 @@ class EvalWorker:
                 return None
             if deadline is not None and time.monotonic() > deadline:
                 return None
-            if self.rng.random() < self.eps:
-                action = int(self.rng.integers(self.env.spec.num_actions))
+            if not discrete:
+                action = np.asarray(policy(obs))  # deterministic mu(s)
             else:
-                action = int(np.argmax(self.query(obs)))
+                # always query (recurrent policies must advance their
+                # state every step), then eps-explore on top
+                q = policy(obs)
+                if self.rng.random() < self.eps:
+                    action = int(
+                        self.rng.integers(self.env.spec.num_actions))
+                else:
+                    action = int(np.argmax(q))
             obs, reward, done, info = self.env.step(action)
             ep_return += info.get("raw_reward", reward)
             if done:

@@ -135,3 +135,9 @@ class DQNLearner:
             td_abs: jax.Array) -> TrainState:
         return state._replace(
             replay=self.replay.add(state.replay, items, td_abs))
+
+    def publish_params(self, state: TrainState) -> Any:
+        """Independent param copy for the inference server — the train/add
+        jits donate the TrainState, so aliased buffers would be deleted
+        under the server's feet."""
+        return jax.tree.map(jnp.copy, state.params)

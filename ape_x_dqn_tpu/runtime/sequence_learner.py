@@ -1,0 +1,112 @@
+"""R2D2 sequence learner: sample -> unroll -> update -> priorities, one jit.
+
+The recurrent counterpart of runtime/learner.DQNLearner (SURVEY.md §3.4,
+config 4): sequences with stored LSTM state are items in the generic
+device-resident prioritized replay, and one donated XLA graph fuses
+stratified sequence sampling, the burn-in unroll, the n-step double-DQN
+sequence loss with value rescaling, the optimizer update, the eta-mix
+priority write-back, and the periodic target sync. The LSTM unroll is a
+`lax.scan` inside the jit (models/lstm_q.py), so the whole train step is
+a single device dispatch regardless of sequence length.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ape_x_dqn_tpu.ops.losses import make_r2d2_loss
+from ape_x_dqn_tpu.replay.sequence import batch_to_sequence_batch
+from ape_x_dqn_tpu.runtime.learner import TrainState, make_optimizer
+
+
+class SequenceLearner:
+    """Jitted endpoints for the R2D2 sequence-replay learner.
+
+    Reuses TrainState: the replay field holds sequence items
+    (replay/sequence.sequence_item_spec) instead of flat transitions.
+    """
+
+    def __init__(self, net_apply_seq: Callable, replay, lcfg, rcfg,
+                 optimizer: optax.GradientTransformation | None = None):
+        """net_apply_seq(params, obs[B,T,...], (c,h)) -> (q[B,T,A], state)."""
+        self.net_apply_seq = net_apply_seq
+        self.replay = replay
+        self.lcfg = lcfg
+        self.optimizer = optimizer or make_optimizer(lcfg)
+        self.loss_fn = make_r2d2_loss(
+            net_apply_seq, burn_in=rcfg.burn_in, n_step=lcfg.n_step,
+            gamma=lcfg.gamma, huber_delta=lcfg.huber_delta,
+            double=lcfg.double_dqn, rescale=lcfg.value_rescale,
+            priority_eta=rcfg.priority_eta)
+
+    # -- state ------------------------------------------------------------
+
+    def init(self, params: Any, replay_state, rng: jax.Array) -> TrainState:
+        return TrainState(
+            params=params,
+            target_params=jax.tree.map(jnp.copy, params),
+            opt_state=self.optimizer.init(params),
+            replay=replay_state,
+            rng=rng,
+            step=jnp.int32(0))
+
+    # -- core step (pure) -------------------------------------------------
+
+    def _train_step(self, state: TrainState) -> tuple[TrainState, dict]:
+        rng, sk = jax.random.split(state.rng)
+        items, idx, is_w = self.replay.sample(
+            state.replay, sk, self.lcfg.batch_size)
+        batch = batch_to_sequence_batch(items)
+        (loss, aux), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(
+            state.params, state.target_params, batch, is_w)
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        # aux["td_abs"] already carries the eta-mixed sequence priority
+        replay_state = self.replay.update_priorities(
+            state.replay, idx, aux["td_abs"])
+        step = state.step + 1
+        sync = (step % self.lcfg.target_sync_every == 0)
+        target_params = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), state.target_params, params)
+        metrics = {
+            "loss": loss,
+            "q_mean": aux["q_mean"],
+            "td_abs_mean": aux["td_abs"].mean(),
+            "valid_frac": aux["valid_frac"],
+            "grad_norm": optax.global_norm(grads),
+        }
+        new_state = TrainState(params, target_params, opt_state,
+                               replay_state, rng, step)
+        return new_state, metrics
+
+    # -- jitted endpoints --------------------------------------------------
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state: TrainState):
+        return self._train_step(state)
+
+    @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
+    def train_many(self, state: TrainState, n: int):
+        """n grad-steps in one dispatch via lax.scan (driver hot loop)."""
+        def body(s, _):
+            s, m = self._train_step(s)
+            return s, m
+        state, metrics = jax.lax.scan(body, state, None, length=n)
+        return state, jax.tree.map(lambda x: x[-1], metrics)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add(self, state: TrainState, items: Any,
+            td_abs: jax.Array) -> TrainState:
+        return state._replace(
+            replay=self.replay.add(state.replay, items, td_abs))
+
+    def publish_params(self, state: TrainState) -> Any:
+        """Donation-safe param copy for the inference server."""
+        return jax.tree.map(jnp.copy, state.params)

@@ -1,9 +1,12 @@
 """Checkpoint / resume (SURVEY.md §5).
 
-Saves params, target params, optimizer state, learner step, and actor
-epsilon-schedule state via Orbax; replay contents are optionally included
-(large — off by default). Resume must reproduce metric continuity, which
-``tests/test_checkpoint.py`` asserts.
+Orbax-backed manager. The driver (runtime/driver.py) saves params, target
+params, optimizer state, RNG, and the grad-step counter on its
+``checkpoint_every`` cadence plus once at shutdown, and restores the
+latest checkpoint at construction; replay contents are not saved (large,
+and Ape-X regenerates them — actors refill the buffer on resume).
+``tests/test_checkpoint.py`` asserts the round-trip is bitwise and that a
+resumed run continues the grad-step counter.
 """
 
 from __future__ import annotations

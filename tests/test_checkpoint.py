@@ -1,0 +1,74 @@
+"""Checkpoint/resume: Orbax round-trip and driver resume continuity
+(SURVEY.md §5 "Checkpoint / resume")."""
+
+import jax
+import numpy as np
+
+from ape_x_dqn_tpu.configs import (
+    ActorConfig, InferenceConfig, LearnerConfig, ReplayConfig, get_config)
+from ape_x_dqn_tpu.runtime.driver import ApexDriver
+from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
+
+
+def _ckpt_cfg(tmp_path, **kw):
+    return get_config("cartpole_smoke").replace(
+        actors=ActorConfig(num_actors=1, base_eps=0.6, ingest_batch=16),
+        replay=ReplayConfig(kind="prioritized", capacity=2048, min_fill=64),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=20,
+        eval_every_steps=0, eval_episodes=0,
+        **kw)
+
+
+def test_checkpoint_manager_roundtrip(tmp_path):
+    mngr = CheckpointManager(str(tmp_path / "m"))
+    payload = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)},
+               "step": np.int32(7)}
+    mngr.save(7, payload, wait=True)
+    assert mngr.latest_step() == 7
+    got = mngr.restore(template=jax.tree.map(np.zeros_like, payload))
+    np.testing.assert_array_equal(got["params"]["w"], payload["params"]["w"])
+    assert int(got["step"]) == 7
+    mngr.close()
+
+
+def test_driver_saves_and_resumes(tmp_path):
+    cfg = _ckpt_cfg(tmp_path)
+    d1 = ApexDriver(cfg)
+    out1 = d1.run(total_env_frames=1500, max_grad_steps=50,
+                  wall_clock_limit_s=120)
+    assert out1["actor_errors"] == [] and out1["loop_errors"] == []
+    assert out1["grad_steps"] >= 50
+    assert d1.ckpt.latest_step() == out1["grad_steps"]
+    final_params = jax.tree.map(np.asarray, d1.state.params)
+
+    # a fresh driver restores the latest checkpoint bitwise and resumes
+    # the grad-step counter
+    d2 = ApexDriver(cfg)
+    assert d2._grad_steps_total == out1["grad_steps"]
+    restored = jax.tree.map(np.asarray, d2.state.params)
+    jax.tree.map(np.testing.assert_array_equal, final_params, restored)
+    # restored params were published to the fresh inference server
+    assert d2.server.params_version == out1["grad_steps"]
+
+    # the resumed run continues to an ABSOLUTE grad-step target
+    out2 = d2.run(total_env_frames=1500,
+                  max_grad_steps=out1["grad_steps"] + 20,
+                  wall_clock_limit_s=120)
+    assert out2["actor_errors"] == [] and out2["loop_errors"] == []
+    assert out2["grad_steps"] >= out1["grad_steps"] + 20
+    assert d2.ckpt.latest_step() == out2["grad_steps"]
+
+
+def test_driver_without_checkpoint_dir_has_no_manager():
+    cfg = get_config("cartpole_smoke").replace(
+        actors=ActorConfig(num_actors=1),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0))
+    d = ApexDriver(cfg)
+    try:
+        assert d.ckpt is None
+    finally:
+        d.server.stop()

@@ -1,0 +1,144 @@
+"""Ape-X DPG runtime: continuous actor, fused DPG learner, and the full
+driver wiring on the pendulum swing-up task (SURVEY.md §2.1 config 5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.configs import (
+    ActorConfig, EnvConfig, InferenceConfig, LearnerConfig, NetworkConfig,
+    ParallelConfig, ReplayConfig, get_config)
+from ape_x_dqn_tpu.comm.transport import LoopbackTransport
+from ape_x_dqn_tpu.models import DPGActor, DPGCritic
+from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+from ape_x_dqn_tpu.runtime.actor import ContinuousActor
+from ape_x_dqn_tpu.runtime.dpg_learner import (
+    DPGLearner, continuous_item_spec)
+from ape_x_dqn_tpu.runtime.driver import ApexDriver
+
+
+def _dpg_cfg(num_actors=2):
+    return get_config("apex_dpg").replace(
+        env=EnvConfig(id="pendulum", kind="control"),
+        network=NetworkConfig(kind="dpg", dpg_hidden=(64, 64),
+                              compute_dtype="float32"),
+        replay=ReplayConfig(kind="prioritized", capacity=16_384,
+                            min_fill=256),
+        learner=LearnerConfig(batch_size=64, n_step=5, gamma=0.99,
+                              critic_lr=1e-3, policy_lr=5e-4, tau=0.01,
+                              publish_every=25, train_chunk=4),
+        actors=ActorConfig(num_actors=num_actors, ingest_batch=32,
+                           noise_sigma=0.15),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        parallel=ParallelConfig(dp=1, tp=1),
+        eval_every_steps=0, eval_episodes=3,
+    )
+
+
+def test_continuous_actor_ships_transitions():
+    cfg = _dpg_cfg(num_actors=1)
+    transport = LoopbackTransport()
+
+    def query_fn(obs):
+        return {"a": np.array([0.5], np.float32), "q": np.float32(1.0)}
+
+    actor = ContinuousActor(cfg, 0, query_fn, transport)
+    frames = actor.run(max_frames=300)
+    assert frames == 300
+    batches, total = [], 0
+    while True:
+        b = transport.recv_experience(timeout=0.01)
+        if b is None:
+            break
+        batches.append(b)
+        total += len(b["priorities"])
+    assert batches, "actor shipped nothing"
+    b0 = batches[0]
+    assert b0["obs"].shape[1:] == (3,)
+    assert b0["action"].shape[1:] == (1,)
+    assert b0["action"].dtype == np.float32
+    # exploration noise moves actions off the deterministic 0.5
+    assert np.std(b0["action"]) > 0.01
+    # actions stay inside the env's box
+    assert (np.abs(b0["action"]) <= 2.0 + 1e-6).all()
+    assert (b0["priorities"] >= 0).all()
+    assert sum(b["frames"] for b in batches) == 300
+    assert total > 250
+
+
+def test_dpg_learner_trains_and_polyaks_targets():
+    actor = DPGActor(action_dim=1, action_low=-2, action_high=2,
+                     hidden=(16, 16))
+    critic = DPGCritic(hidden=(16, 16))
+    obs0 = jnp.zeros((1, 3), jnp.float32)
+    a0 = jnp.zeros((1, 1), jnp.float32)
+    actor_params = actor.init(jax.random.key(0), obs0)
+    critic_params = critic.init(jax.random.key(1), obs0, a0)
+    replay = PrioritizedReplay(capacity=256)
+    spec = continuous_item_spec((3,), np.float32, 1)
+    lcfg = LearnerConfig(batch_size=32, n_step=5, critic_lr=1e-3,
+                         policy_lr=1e-4, tau=0.05)
+    learner = DPGLearner(actor.apply, critic.apply, replay, lcfg)
+    state = learner.init(actor_params, critic_params, replay.init(spec),
+                         jax.random.key(2))
+    rng = np.random.default_rng(0)
+    items = {
+        "obs": jnp.asarray(rng.normal(size=(64, 3)), jnp.float32),
+        "action": jnp.asarray(rng.uniform(-2, 2, (64, 1)), jnp.float32),
+        "reward": jnp.asarray(rng.normal(size=(64,)), jnp.float32),
+        "next_obs": jnp.asarray(rng.normal(size=(64, 3)), jnp.float32),
+        "discount": jnp.full((64,), 0.95, jnp.float32),
+    }
+    state = learner.add(state, items, jnp.ones(64))
+    target_before = jax.tree.map(np.asarray, state.target_critic)
+    online_before = jax.tree.map(np.asarray, state.critic_params)
+    state, m = learner.train_step(state)
+    assert np.isfinite(m["loss"]) and np.isfinite(m["policy_loss"])
+    assert int(state.step) == 1
+    # Polyak: targets moved toward (but not onto) the online params
+    t_after = jax.tree.leaves(jax.tree.map(np.asarray,
+                                           state.target_critic))
+    t_before = jax.tree.leaves(target_before)
+    o_before = jax.tree.leaves(online_before)
+    moved = any(not np.allclose(a, b) for a, b in zip(t_after, t_before))
+    assert moved
+    not_equal_online = any(
+        not np.allclose(a, b)
+        for a, b in zip(t_after,
+                        jax.tree.leaves(jax.tree.map(
+                            np.asarray, state.critic_params))))
+    assert not_equal_online
+    state, m = learner.train_many(state, 3)
+    assert int(state.step) == 4
+
+
+def test_dpg_driver_end_to_end():
+    """Full continuous wiring: noisy actors -> batched mu+Q inference ->
+    ingest -> fused DPG learner -> deterministic eval."""
+    cfg = _dpg_cfg(num_actors=2)
+    driver = ApexDriver(cfg)
+    assert driver.family == "dpg"
+    out = driver.run(total_env_frames=3000, max_grad_steps=60,
+                     wall_clock_limit_s=240)
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert out["loop_errors"] == [], out["loop_errors"]
+    assert out["grad_steps"] >= 60, out
+    assert out["frames"] >= 300, out
+    assert out["episodes"] > 0
+    assert driver.server.params_version > 0
+    assert out["eval"] is not None and out["eval"]["episodes"] > 0
+
+
+@pytest.mark.slow
+def test_dpg_improves_pendulum():
+    """Rising return on pendulum swing-up: the trained deterministic
+    policy must clearly beat the random-policy plateau (~ -1400).
+    Measured dynamics: greedy eval reaches ~ -43 after ~45k frames /
+    4 wall-clock minutes on the CPU test harness."""
+    cfg = _dpg_cfg(num_actors=2).replace(total_env_frames=60_000)
+    driver = ApexDriver(cfg)
+    out = driver.run(max_grad_steps=10**9, wall_clock_limit_s=600)
+    assert out["actor_errors"] == [] and out["loop_errors"] == []
+    assert out["eval"] is not None
+    assert out["eval"]["mean_return"] > -400, out["eval"]

@@ -1,0 +1,175 @@
+"""R2D2 runtime: recurrent actor, sequence learner, and the full driver
+wiring over stored-state sequence replay (SURVEY.md §2.1 config 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.configs import (
+    ActorConfig, EnvConfig, InferenceConfig, LearnerConfig, NetworkConfig,
+    ParallelConfig, ReplayConfig, get_config)
+from ape_x_dqn_tpu.comm.transport import LoopbackTransport
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.models import ApeXLSTMQNet
+from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+from ape_x_dqn_tpu.replay.sequence import (
+    SequenceBuilder, sequence_item_spec, split_priorities)
+from ape_x_dqn_tpu.runtime.actor import RecurrentActor
+from ape_x_dqn_tpu.runtime.driver import ApexDriver
+from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
+
+
+def _r2d2_cfg(num_actors=2, lstm=32, seq=16, overlap=8, burn_in=4):
+    return get_config("r2d2").replace(
+        env=EnvConfig(id="CartPolePO", kind="cartpole_po"),
+        network=NetworkConfig(kind="lstm_q", lstm_size=lstm, torso_dense=64,
+                              dueling=True, compute_dtype="float32"),
+        replay=ReplayConfig(kind="sequence", capacity=512, seq_length=seq,
+                            seq_overlap=overlap, burn_in=burn_in,
+                            min_fill=32, priority_eta=0.9),
+        learner=LearnerConfig(batch_size=16, n_step=3, value_rescale=True,
+                              target_sync_every=100, lr=1e-3,
+                              publish_every=25, train_chunk=4),
+        actors=ActorConfig(num_actors=num_actors, base_eps=0.4,
+                           ingest_batch=64),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        parallel=ParallelConfig(dp=1, tp=1),
+        eval_every_steps=0,
+    )
+
+
+def test_masked_cartpole_hides_velocities():
+    env = make_env(EnvConfig(kind="cartpole_po"), seed=0)
+    obs = env.reset()
+    assert obs.shape == (2,)
+    obs2, r, done, info = env.step(1)
+    assert obs2.shape == (2,) and r == 1.0
+
+
+def test_sequence_builder_actor_side_priority():
+    sb = SequenceBuilder(seq_len=4, overlap=0, lstm_size=2,
+                         priority_eta=0.9)
+    pre = (np.zeros(2), np.zeros(2))
+    out = []
+    for t, td in enumerate([1.0, 2.0, 3.0, 4.0]):
+        out += sb.append(np.array([t]), t, 0.0, False, pre, td=td)
+    assert len(out) == 1
+    # eta-mix: 0.9*max + 0.1*mean = 0.9*4 + 0.1*2.5
+    np.testing.assert_allclose(out[0]["priority"], 0.9 * 4 + 0.1 * 2.5)
+    items, pris = split_priorities(out)
+    assert "priority" not in items[0]
+    np.testing.assert_allclose(pris, [out[0]["priority"]])
+
+
+def test_recurrent_actor_ships_sequences():
+    cfg = _r2d2_cfg(num_actors=1, seq=8, overlap=4)
+    transport = LoopbackTransport()
+    lstm = cfg.network.lstm_size
+
+    def query_fn(inp):
+        # fake recurrent net: state accumulates, q fixed
+        return {"q": np.array([0.1, 0.2], np.float32),
+                "c": np.asarray(inp["c"]) + 1.0,
+                "h": np.asarray(inp["h"]) + 1.0}
+
+    actor = RecurrentActor(cfg, 0, query_fn, transport)
+    frames = actor.run(max_frames=100)
+    assert frames == 100
+    batches, total = [], 0
+    while True:
+        b = transport.recv_experience(timeout=0.01)
+        if b is None:
+            break
+        batches.append(b)
+        total += len(b["priorities"])
+    assert batches, "actor shipped nothing"
+    b0 = batches[0]
+    seq = cfg.replay.seq_length
+    assert b0["obs"].shape[1:] == (seq, 2)
+    assert b0["actions"].shape[1:] == (seq,)
+    assert b0["init_c"].shape[1:] == (lstm,)
+    assert (b0["priorities"] > 0).all()
+    assert (b0["mask"].sum(axis=1) >= 1).all()
+    # frames are accounted separately from sequence counts
+    assert sum(b["frames"] for b in batches) == 100
+    # init states advance with the fake recurrence except at episode
+    # starts (zeros)
+    assert any(np.any(b["init_c"] != 0) for b in batches)
+
+
+def test_sequence_learner_trains_and_updates_priorities():
+    cfg = _r2d2_cfg()
+    net = ApeXLSTMQNet(num_actions=2, lstm_size=8, dense=16,
+                       compute_dtype="float32", mlp_torso=True)
+    z = jnp.zeros((1, 8), jnp.float32)
+    params = net.init(jax.random.key(0),
+                      jnp.zeros((1, 4, 2), jnp.float32), (z, z))
+    replay = PrioritizedReplay(capacity=64)
+    spec = sequence_item_spec((2,), np.float32, 4, 8)
+    lcfg = cfg.learner.__class__(batch_size=8, n_step=2, value_rescale=True,
+                                 target_sync_every=10, lr=1e-3)
+    rcfg = cfg.replay.__class__(seq_length=4, burn_in=1)
+    learner = SequenceLearner(lambda p, o, s: net.apply(p, o, s),
+                              replay, lcfg, rcfg)
+    state = learner.init(params, replay.init(spec), jax.random.key(1))
+    rng = np.random.default_rng(0)
+    items = {
+        "obs": jnp.asarray(rng.normal(size=(16, 4, 2)), jnp.float32),
+        "actions": jnp.asarray(rng.integers(0, 2, (16, 4)), jnp.int32),
+        "rewards": jnp.asarray(rng.normal(size=(16, 4)), jnp.float32),
+        "terminals": jnp.zeros((16, 4), jnp.float32),
+        "mask": jnp.ones((16, 4), jnp.float32),
+        "init_c": jnp.zeros((16, 8), jnp.float32),
+        "init_h": jnp.zeros((16, 8), jnp.float32),
+    }
+    state = learner.add(state, items, jnp.ones(16))
+    assert int(state.replay.size) == 16
+    tree_before = np.asarray(state.replay.tree).copy()
+    state, m = learner.train_step(state)
+    assert np.isfinite(m["loss"])
+    assert int(state.step) == 1
+    # priorities were written back into the sum-tree
+    assert not np.allclose(np.asarray(state.replay.tree), tree_before)
+    state, m = learner.train_many(state, 3)
+    assert int(state.step) == 4
+    assert np.isfinite(m["loss"]) and m["valid_frac"] > 0
+
+
+def test_r2d2_driver_end_to_end():
+    """Full recurrent wiring: recurrent actors -> batched stateful
+    inference -> sequence ingest -> sequence learner -> recurrent eval."""
+    cfg = _r2d2_cfg(num_actors=2).replace(eval_every_steps=50,
+                                          eval_episodes=2)
+    driver = ApexDriver(cfg)
+    assert driver.family == "r2d2"
+    out = driver.run(total_env_frames=2500, max_grad_steps=60,
+                     wall_clock_limit_s=240)
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert out["loop_errors"] == [], out["loop_errors"]
+    assert out["grad_steps"] >= 60, out
+    assert out["frames"] >= 100, out
+    assert out["episodes"] > 0
+    assert driver.server.params_version > 0
+    # the guaranteed end-of-training eval ran with the recurrent policy
+    assert out["eval"] is not None and out["eval"]["episodes"] > 0
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.slow
+def test_r2d2_improves_masked_cartpole():
+    """Reward slope on the POMDP task: the recurrent agent must beat the
+    random plateau (~22 per episode) by a clear margin. Measured
+    dynamics: behaviour avg return reaches ~60-70 inside 7 wall-clock
+    minutes on the CPU test harness."""
+    cfg = _r2d2_cfg(num_actors=2, lstm=64).replace(
+        eval_every_steps=0, eval_episodes=10, total_env_frames=40_000)
+    driver = ApexDriver(cfg)
+    out = driver.run(max_grad_steps=10**9, wall_clock_limit_s=480)
+    assert out["actor_errors"] == [] and out["loop_errors"] == []
+    # the greedy recurrent eval is high-variance on this tiny task (single
+    # episodes span 9..500); 10 episodes + a margin over the untrained
+    # plateau (~22) keeps the slope assertion robust
+    assert out["eval"] is not None
+    assert out["eval"]["mean_return"] > 35, out["eval"]
