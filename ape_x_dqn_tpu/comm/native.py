@@ -1,0 +1,116 @@
+"""ctypes bindings for the native framing codec (cpp/framing.cpp).
+
+The .so is compiled lazily with g++ the first time it's needed and
+cached next to the source; if no compiler is available the pure-Python
+fallbacks (zlib.crc32 + bytes joins) are wire-compatible, so a
+C++-enabled learner host can talk to a Python-only actor host.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "cpp", "framing.cpp")
+_SO = os.path.join(os.path.dirname(_SRC), "libapex_framing.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
+                    check=True, capture_output=True, timeout=120)
+            lib = ctypes.CDLL(_SO)
+            lib.apex_crc32.restype = ctypes.c_uint32
+            lib.apex_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint32]
+            lib.apex_pack.restype = ctypes.c_uint64
+            lib.apex_pack.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+            lib.apex_unpack_offsets.restype = ctypes.c_uint64
+            lib.apex_unpack_offsets.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+            _lib = lib
+        except Exception:
+            _lib = None  # no toolchain: Python fallback
+        return _lib
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+def crc32(data: bytes | memoryview, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        return zlib.crc32(bytes(data), seed) & 0xFFFFFFFF
+    buf = bytes(data) if isinstance(data, memoryview) else data
+    return int(lib.apex_crc32(buf, len(buf), seed))
+
+
+def pack_records(chunks: list[bytes]) -> bytes:
+    """Gather chunks into one [u64 len][bytes]* frame (native memcpy)."""
+    lib = _load()
+    if lib is None:
+        out = bytearray()
+        for c in chunks:
+            out += len(c).to_bytes(8, "little") + c
+        return bytes(out)
+    total = sum(len(c) for c in chunks) + 8 * len(chunks)
+    dst = ctypes.create_string_buffer(total)
+    n = len(chunks)
+    srcs = (ctypes.c_void_p * n)()
+    lens = (ctypes.c_uint64 * n)()
+    # keep refs so the buffers stay alive across the call
+    keep = []
+    for i, c in enumerate(chunks):
+        b = c if isinstance(c, bytes) else bytes(c)
+        keep.append(b)
+        srcs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+        lens[i] = len(b)
+    wrote = lib.apex_pack(ctypes.cast(dst, ctypes.c_void_p), srcs, lens, n)
+    assert wrote == total, (wrote, total)
+    return dst.raw
+
+
+def unpack_records(frame: bytes, max_records: int = 4096) -> list[bytes]:
+    """Inverse of pack_records; raises ValueError on malformed frames."""
+    lib = _load()
+    if lib is None:
+        out, off = [], 0
+        ln = len(frame)
+        while off < ln:
+            if off + 8 > ln:
+                raise ValueError("malformed frame")
+            rec = int.from_bytes(frame[off:off + 8], "little")
+            off += 8
+            if off + rec > ln:
+                raise ValueError("malformed frame")
+            out.append(frame[off:off + rec])
+            off += rec
+        return out
+    offs = (ctypes.c_uint64 * max_records)()
+    lens = (ctypes.c_uint64 * max_records)()
+    n = lib.apex_unpack_offsets(frame, len(frame), offs, lens, max_records)
+    if n == ctypes.c_uint64(-1).value:
+        raise ValueError("malformed frame")
+    return [frame[offs[i]:offs[i] + lens[i]] for i in range(n)]

@@ -1,0 +1,342 @@
+"""TCP transport: actor hosts -> learner host over DCN.
+
+The reference crosses hosts with gRPC (SURVEY.md §2.2 "Comm: gRPC",
+§2.3 item 3); the TPU-native runtime keeps ICI for learner collectives
+and weight publication (parallel/dist_learner.py) and uses this plain
+TCP layer only for the host-side paths: experience ingest into the
+learner host and parameter pulls by actor hosts.
+
+Wire format (both directions), assembled/verified by the native codec
+(comm/native.py -> cpp/framing.cpp, Python-fallback compatible):
+
+    [u32 magic 'APEX'][u8 type][u32 crc32(payload)][u64 len][payload]
+
+Experience payloads are pack_records([json header, raw array bytes...])
+— zero pickle on the hot path. Parameter payloads (low-rate control
+plane) are pickled pytrees.
+
+Semantics match LoopbackTransport: ingest is lossy-tolerant (bounded
+queue, drop-oldest under backpressure; a dead learner connection drops
+batches rather than killing the actor), so actor loss / learner restart
+degrade gracefully (SURVEY.md §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import queue
+import socket
+import struct
+import threading
+from typing import Any
+
+import numpy as np
+
+from ape_x_dqn_tpu.comm import native
+
+MAGIC = 0x41504558  # 'APEX'
+MSG_EXPERIENCE = 1
+MSG_PARAMS_REQ = 2
+MSG_PARAMS = 3
+
+_HDR = struct.Struct("<IBIQ")  # magic, type, crc, payload_len
+MAX_PAYLOAD = 1 << 31
+
+
+# -- codec ------------------------------------------------------------------
+
+
+def encode_batch(batch: dict) -> bytes:
+    """Experience dict (numpy arrays + scalars) -> framed payload."""
+    meta, arrays = [], []
+    for k, v in batch.items():
+        if isinstance(v, np.ndarray):
+            v = np.ascontiguousarray(v)
+            meta.append({"k": k, "nd": True, "dt": v.dtype.str,
+                         "sh": list(v.shape)})
+            arrays.append(v.tobytes())
+        else:
+            meta.append({"k": k, "nd": False, "v": v})
+    return native.pack_records([json.dumps(meta).encode()] + arrays)
+
+
+def decode_batch(payload: bytes) -> dict:
+    recs = native.unpack_records(payload)
+    meta = json.loads(recs[0].decode())
+    out: dict = {}
+    i = 1
+    for m in meta:
+        if m["nd"]:
+            arr = np.frombuffer(recs[i], dtype=np.dtype(m["dt"]))
+            out[m["k"]] = arr.reshape(m["sh"]).copy()
+            i += 1
+        else:
+            out[m["k"]] = m["v"]
+    return out
+
+
+def _send_msg(sock: socket.socket, mtype: int, payload: bytes) -> None:
+    hdr = _HDR.pack(MAGIC, mtype, native.crc32(payload), len(payload))
+    sock.sendall(hdr + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> tuple[int, bytes] | None:
+    hdr = _recv_exact(sock, _HDR.size)
+    if hdr is None:
+        return None
+    magic, mtype, crc, ln = _HDR.unpack(hdr)
+    if magic != MAGIC or ln > MAX_PAYLOAD:
+        raise ValueError("bad frame header")
+    payload = _recv_exact(sock, ln)
+    if payload is None:
+        return None
+    if native.crc32(payload) != crc:
+        raise ValueError("checksum mismatch")
+    return mtype, payload
+
+
+# -- learner-host side ------------------------------------------------------
+
+
+class SocketIngestServer:
+    """Transport implementation that listens for remote actor hosts.
+
+    Drop-in for LoopbackTransport on the learner host: recv_experience
+    drains a bounded queue fed by per-connection reader threads;
+    publish_params caches a pickled blob that MSG_PARAMS_REQ replies
+    serve without re-serializing per client.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 max_pending: int = 64):
+        self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
+        self._dropped = 0
+        self._params: tuple[Any, int] = (None, -1)
+        self._params_blob: bytes | None = pickle.dumps((None, -1))
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._conns: list[socket.socket] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ingest-accept", daemon=True)
+        self._accept_thread.start()
+
+    # Transport interface (learner side)
+
+    def recv_experience(self, timeout: float | None = None) -> dict | None:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def send_experience(self, batch: dict) -> None:
+        """Local actors on the learner host share the same queue."""
+        while True:
+            try:
+                self._q.put_nowait(batch)
+                return
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                    self._dropped += 1
+                except queue.Empty:
+                    pass
+
+    def publish_params(self, params: Any, version: int) -> None:
+        # store the tree and serialize lazily on the first MSG_PARAMS_REQ
+        # per version: device->host transfer + pickling a multi-MB CNN
+        # tree would otherwise run synchronously on the learner thread at
+        # every publish boundary, stalling training dispatches — and is
+        # pure waste when no remote host is connected
+        with self._lock:
+            self._params = (params, version)
+            self._params_blob = None
+
+    def _param_blob(self) -> bytes:
+        with self._lock:
+            if self._params_blob is None:
+                params, version = self._params
+                self._params_blob = pickle.dumps(
+                    (jax_to_numpy(params), version),
+                    protocol=pickle.HIGHEST_PROTOCOL)
+            return self._params_blob
+
+    def get_params(self) -> tuple[Any, int]:
+        return pickle.loads(self._param_blob())
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    @property
+    def pending(self) -> int:
+        return self._q.qsize()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._accept_thread.join(timeout=2)
+        for c in list(self._conns):
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._listener.close()
+
+    # internals
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            threading.Thread(target=self._reader, args=(conn,),
+                             name="ingest-reader", daemon=True).start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return  # peer closed: actor loss is tolerated
+                mtype, payload = msg
+                if mtype == MSG_EXPERIENCE:
+                    self.send_experience(decode_batch(payload))
+                elif mtype == MSG_PARAMS_REQ:
+                    _send_msg(conn, MSG_PARAMS, self._param_blob())
+        except (OSError, ValueError):
+            return  # dead/corrupt connection: drop it, keep serving others
+        finally:
+            try:
+                self._conns.remove(conn)  # actor churn must not leak socks
+            except ValueError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def jax_to_numpy(params: Any) -> Any:
+    import jax
+    return jax.tree.map(np.asarray, params) if params is not None else None
+
+
+# -- actor-host side --------------------------------------------------------
+
+
+class SocketTransport:
+    """Transport for a remote actor host: pushes experience, pulls params.
+
+    send_experience never raises into the actor loop: on a broken
+    connection it attempts one reconnect and otherwise counts the batch
+    as dropped (Ape-X ingest is lossy-tolerant; the actor keeps
+    generating experience for when the learner returns).
+    """
+
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+        self._addr = (host, port)
+        self._timeout = connect_timeout
+        self._sock: socket.socket | None = None
+        self._param_sock: socket.socket | None = None
+        self._dropped = 0
+        # independent locks: a param pull blocking on the network (up to
+        # the connect timeout) must not stall the actor threads' experience
+        # sends — they use different sockets and share no state
+        self._send_lock = threading.Lock()
+        self._param_lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def send_experience(self, batch: dict) -> None:
+        payload = encode_batch(batch)
+        with self._send_lock:
+            for _ in range(2):  # current socket, then one reconnect
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_msg(self._sock, MSG_EXPERIENCE, payload)
+                    return
+                except OSError:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                    self._sock = None
+            self._dropped += 1
+
+    def recv_experience(self, timeout: float | None = None) -> dict | None:
+        raise RuntimeError("actor-side transport cannot receive experience")
+
+    def publish_params(self, params: Any, version: int) -> None:
+        raise RuntimeError("actor-side transport cannot publish params")
+
+    def get_params(self) -> tuple[Any, int]:
+        with self._param_lock:
+            try:
+                if self._param_sock is None:
+                    self._param_sock = self._connect()
+                _send_msg(self._param_sock, MSG_PARAMS_REQ, b"")
+                msg = _recv_msg(self._param_sock)
+                # a corrupt/misframed reply (ValueError from _recv_msg, or
+                # an unexpected type) is treated like a dead connection:
+                # reset the socket and report no params — the caller polls
+                # again. It must never escape into the param-puller thread.
+                if msg is not None and msg[0] != MSG_PARAMS:
+                    raise ValueError(f"unexpected reply type {msg[0]}")
+            except (OSError, ValueError):
+                msg = None
+            if msg is None:
+                if self._param_sock is not None:
+                    try:
+                        self._param_sock.close()
+                    except OSError:
+                        pass
+                self._param_sock = None
+                return None, -1
+        try:
+            return pickle.loads(msg[1])
+        except Exception:
+            return None, -1
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    @property
+    def pending(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        with self._send_lock, self._param_lock:
+            for s in (self._sock, self._param_sock):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._sock = self._param_sock = None

@@ -79,6 +79,12 @@ class LearnerConfig:
     # grad-steps fused into one train_many dispatch in the driver hot loop
     # (lax.scan on device; no host round-trips between steps)
     train_chunk: int = 8
+    # Pacing: cap grad-steps at this multiple of ingested transitions
+    # (None = free-run, the Ape-X default where the learner trains as
+    # fast as the device allows). Bounds replay overfit when actors are
+    # slow relative to the learner, and on shared-core test hosts stops
+    # the learner starving actor inference.
+    steps_per_frame_cap: float | None = None
     # DPG
     critic_lr: float = 1e-3
     policy_lr: float = 1e-4

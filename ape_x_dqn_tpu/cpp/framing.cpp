@@ -1,0 +1,79 @@
+// Native data plane for the socket transport (comm/socket_transport.py).
+//
+// The reference moves experience between hosts through gRPC's C++ core
+// (SURVEY.md §2.2 "Comm: gRPC"); the TPU-native equivalent keeps the
+// wire hot path out of Python the same way: message assembly (gather
+// many numpy buffers into one length-prefixed frame) and integrity
+// checksums run in this compiled module, invoked via ctypes with
+// zero-copy pointers. Python only decides WHAT to send; bytes move here.
+//
+// Build: g++ -O3 -shared -fPIC framing.cpp -o libapex_framing.so
+// (done lazily by ape_x_dqn_tpu/comm/native.py and cached).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// CRC-32 (IEEE 802.3, reflected 0xEDB88320), table-based, one pass.
+// Matches zlib.crc32 so the Python fallback is wire-compatible.
+static uint32_t CRC_TABLE[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        CRC_TABLE[i] = c;
+    }
+    crc_init_done = true;
+}
+
+uint32_t apex_crc32(const uint8_t* buf, uint64_t len, uint32_t seed) {
+    if (!crc_init_done) crc_init();
+    uint32_t c = seed ^ 0xFFFFFFFFu;
+    for (uint64_t i = 0; i < len; ++i)
+        c = CRC_TABLE[(c ^ buf[i]) & 0xFFu] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// Gather n source buffers into dst as consecutive [u64 length][bytes]
+// records, returning the total bytes written. dst must hold
+// sum(lens) + 8*n bytes. Returns 0 on null input.
+uint64_t apex_pack(uint8_t* dst, const uint8_t** srcs,
+                   const uint64_t* lens, uint64_t n) {
+    if (!dst || !srcs || !lens) return 0;
+    uint64_t off = 0;
+    for (uint64_t i = 0; i < n; ++i) {
+        std::memcpy(dst + off, &lens[i], 8);
+        off += 8;
+        std::memcpy(dst + off, srcs[i], lens[i]);
+        off += lens[i];
+    }
+    return off;
+}
+
+// Split a packed frame back into record offsets/lengths (the inverse of
+// apex_pack's framing). offsets/lengths must hold max_records entries.
+// Returns the number of records parsed, or (uint64_t)-1 on a malformed
+// frame (record overruns the buffer).
+uint64_t apex_unpack_offsets(const uint8_t* buf, uint64_t len,
+                             uint64_t* offsets, uint64_t* lengths,
+                             uint64_t max_records) {
+    uint64_t off = 0, i = 0;
+    while (off < len && i < max_records) {
+        if (off + 8 > len) return (uint64_t)-1;
+        uint64_t rec_len;
+        std::memcpy(&rec_len, buf + off, 8);
+        off += 8;
+        if (off + rec_len > len) return (uint64_t)-1;
+        offsets[i] = off;
+        lengths[i] = rec_len;
+        off += rec_len;
+        ++i;
+    }
+    return (off == len) ? i : (uint64_t)-1;
+}
+
+}  // extern "C"

@@ -1,0 +1,138 @@
+"""Remote actor host: actors on another machine feeding a learner host.
+
+The reference scales to 256 actors by spawning actor processes on many
+machines, each pushing experience and pulling parameters over gRPC
+(SURVEY.md §3.1). The TPU-native equivalent: this module runs N actor
+threads on a CPU host, evaluates the policy on a LOCAL batched inference
+server (CPU jit — actor hosts have no TPU), pushes experience to the
+learner host's SocketIngestServer over DCN, and pulls fresh parameters
+on a cadence through the same connection.
+
+Entry points:
+- run_actor_host(cfg, host, port, ...) — library call.
+- `python -m ape_x_dqn_tpu.runtime.actor_host --config pong
+  --connect HOST:PORT --actors 4` — one actor machine.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ape_x_dqn_tpu.comm.socket_transport import SocketTransport
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
+from ape_x_dqn_tpu.runtime.actor import Actor
+
+
+def run_actor_host(cfg: RunConfig, host: str, port: int,
+                   num_actors: int | None = None,
+                   actor_offset: int = 0,
+                   frames_per_actor: int | None = None,
+                   param_poll_s: float = 2.0,
+                   stop_event: threading.Event | None = None,
+                   wait_for_params_s: float = 60.0) -> dict:
+    """Run actors against a remote learner until their frame budget ends.
+
+    actor_offset positions this host's actors inside the global eps_i
+    schedule (host k of m runs indices [k*n, (k+1)*n) of num_actors*m).
+    """
+    n = num_actors or cfg.actors.num_actors
+    if cfg.network.kind in ("lstm_q", "dpg"):
+        # the host's inference path below is the flat-DQN forward; the
+        # recurrent (r2d2) and continuous (dpg) actor families need their
+        # stateful/tuple server protocols (driver.py _server_apply_fn)
+        # plumbed through before remote hosts can run them
+        raise NotImplementedError(
+            f"actor_host supports the flat-DQN family; network kind "
+            f"{cfg.network.kind!r} requires the in-driver actor runtime")
+    stop_event = stop_event or threading.Event()
+    transport = SocketTransport(host, port)
+
+    # wait for the learner to publish a first param set
+    deadline = time.monotonic() + wait_for_params_s
+    params, version = transport.get_params()
+    while params is None and time.monotonic() < deadline \
+            and not stop_event.is_set():
+        time.sleep(0.2)
+        params, version = transport.get_params()
+    if params is None:
+        transport.close()
+        raise TimeoutError("learner never published parameters")
+
+    probe = make_env(cfg.env, seed=cfg.seed)
+    net = build_network(cfg.network, probe.spec)
+    server = BatchedInferenceServer(
+        lambda p, obs: net.apply(p, obs), params,
+        max_batch=cfg.inference.max_batch,
+        deadline_ms=cfg.inference.deadline_ms)
+    server.update_params(params, version)
+
+    def param_puller() -> None:
+        while not stop_event.wait(param_poll_s):
+            p, v = transport.get_params()
+            if p is not None and v > server.params_version:
+                server.update_params(p, v)
+
+    puller = threading.Thread(target=param_puller, name="param-pull",
+                              daemon=True)
+    puller.start()
+
+    per_actor = frames_per_actor or (
+        cfg.total_env_frames // max(cfg.actors.num_actors, 1))
+    errors: list[tuple[int, Exception]] = []
+    frames = [0] * n
+
+    def actor_thread(slot: int) -> None:
+        idx = actor_offset + slot
+        try:
+            actor = Actor(cfg, idx, server.query, transport)
+            frames[slot] = actor.run(per_actor, stop_event)
+        except Exception as e:  # noqa: BLE001 - reported to caller
+            errors.append((idx, e))
+
+    threads = [threading.Thread(target=actor_thread, args=(i,),
+                                name=f"actor-{actor_offset + i}",
+                                daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop_event.set()
+    puller.join(timeout=2)
+    server.stop()
+    transport.close()
+    return {"frames": sum(frames), "actors": n,
+            "dropped": transport.dropped, "errors": errors,
+            "last_param_version": server.params_version}
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    from ape_x_dqn_tpu.configs import get_config
+    from ape_x_dqn_tpu.runtime.train import apply_overrides
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--connect", required=True, metavar="HOST:PORT")
+    ap.add_argument("--actors", type=int, default=None)
+    ap.add_argument("--actor-offset", type=int, default=0)
+    ap.add_argument("--frames-per-actor", type=int, default=None)
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="dotted.key=value")
+    args = ap.parse_args(argv)
+    cfg = apply_overrides(get_config(args.config), args.set)
+    host, port = args.connect.rsplit(":", 1)
+    out = run_actor_host(cfg, host, int(port), num_actors=args.actors,
+                         actor_offset=args.actor_offset,
+                         frames_per_actor=args.frames_per_actor)
+    print(out)
+    return 1 if out["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,7 +49,12 @@ from ape_x_dqn_tpu.utils.rng import component_key
 
 
 class ApexDriver:
-    def __init__(self, cfg: RunConfig, metrics: Metrics | None = None):
+    def __init__(self, cfg: RunConfig, metrics: Metrics | None = None,
+                 transport=None):
+        """transport: a comm Transport for experience ingest + param
+        distribution; defaults to in-process LoopbackTransport. Pass a
+        comm.socket_transport.SocketIngestServer to also accept remote
+        actor hosts over DCN."""
         self.cfg = cfg
         self.metrics = metrics or Metrics()
         probe_env = make_env(cfg.env, seed=cfg.seed)
@@ -144,7 +149,12 @@ class ApexDriver:
             server_params,
             max_batch=cfg.inference.max_batch,
             deadline_ms=cfg.inference.deadline_ms)
-        self.transport = LoopbackTransport()
+        self.transport = transport if transport is not None \
+            else LoopbackTransport()
+        # initial publication so remote actor hosts can bootstrap before
+        # the learner's first publish_every boundary (they block on
+        # get_params); both sides only read these buffers
+        self.transport.publish_params(server_params, 0)
         self.stop_event = threading.Event()
         self.episode_returns: deque[float] = deque(maxlen=200)
         self.frames = Throughput(window_s=30.0)
@@ -160,12 +170,16 @@ class ApexDriver:
         # blocks on a device->host read of state.replay.size (round-1
         # verdict "weak" #4: that sync serialized every iteration)
         self._replay_filled = 0
-        # dist ingest staging: transitions accumulate here until a full
-        # [dp, chunk] block can be shipped to the device in one add
+        # ingest staging: transitions accumulate host-side until a full
+        # fixed-size block ships to the device in one add — [dp, chunk]
+        # on the mesh, [chunk] single-chip. Fixed block shapes matter:
+        # actors ship ragged batch sizes, and every distinct size would
+        # compile a fresh add graph (20-40s each on TPU).
         self._stage: list[dict] = []
         self._stage_n = 0
         self._stage_chunk = max(cfg.actors.ingest_batch, 1)
         self._stage_dropped = 0
+        self._item_spec = item_spec
         self.last_eval: dict | None = None
         # checkpoint/resume (SURVEY.md §5): params/targets/opt/rng/step are
         # saved; replay contents are not (large, and Ape-X tolerates
@@ -306,37 +320,46 @@ class ApexDriver:
                 continue
             n = int(batch["priorities"].shape[0])
             self._ingest_one(batch, n)
-        if self.is_dist:
-            # ship any staged full blocks; account the partial remainder
-            # as dropped (static [dp, B] ingest shapes can't ship it)
-            self._flush_stage(force=True)
+        # ship any staged full blocks plus the remainder (ragged add
+        # single-chip; dropped on the mesh, where shapes are static)
+        self._flush_stage(force=True)
 
     def _ingest_one(self, batch: dict, n: int) -> None:
         # sequence batches carry fewer items than env frames; actors ship
         # the true frame count alongside (flat batches: frames == items)
         frames = int(batch.get("frames", n))
-        if self.is_dist:
-            self._stage.append(batch)
-            self._stage_n += n
-            self._flush_stage()
-        else:
-            items = {k: jnp.asarray(batch[k]) for k in self._item_keys}
-            pris = jnp.asarray(batch["priorities"])
-            with self._state_lock:
-                self.state = self.learner.add(self.state, items, pris)
-            with self._lock:
-                self._replay_filled = min(self._replay_filled + n,
-                                          self.capacity)
+        self._stage.append(batch)
+        self._stage_n += n
+        self._flush_stage()
         self.frames.add(frames)
         with self._lock:
             self._frames_total += frames
             self._ingested_batches += 1
 
+    def _add_block(self, take: dict, count: int) -> None:
+        if self.is_dist:
+            items = {
+                k: jnp.asarray(v).reshape(self.dp, self._stage_chunk,
+                                          *v.shape[1:])
+                for k, v in take.items() if k != "priorities"}
+            pris = jnp.asarray(take["priorities"]).reshape(
+                self.dp, self._stage_chunk)
+        else:
+            items = {k: jnp.asarray(v) for k, v in take.items()
+                     if k != "priorities"}
+            pris = jnp.asarray(take["priorities"])
+        with self._state_lock:
+            self.state = self.learner.add(self.state, items, pris)
+        with self._lock:
+            self._replay_filled = min(self._replay_filled + count,
+                                      self.capacity)
+
     def _flush_stage(self, force: bool = False) -> None:
-        """Ship staged transitions to the dist learner as [dp, chunk, ...]
-        blocks — consecutive chunks land on consecutive shards, the
-        round-robin that keeps shard priority masses balanced
-        (dist_learner.py IS-weight approximation)."""
+        """Ship staged transitions to the learner in fixed-size blocks —
+        [dp, chunk] on the mesh (consecutive chunks round-robin across
+        shards, keeping priority masses balanced for the dist IS-weight
+        approximation), [chunk] single-chip. Fixed shapes keep the add
+        jit at exactly one compiled graph."""
         block = self.dp * self._stage_chunk
         while self._stage_n >= block:
             fields = {
@@ -346,27 +369,55 @@ class ApexDriver:
             rest = {k: v[block:] for k, v in fields.items()}
             self._stage = [rest] if rest["priorities"].shape[0] else []
             self._stage_n -= block
-            items = {
-                k: jnp.asarray(v).reshape(self.dp, self._stage_chunk,
-                                          *v.shape[1:])
-                for k, v in take.items() if k != "priorities"}
-            pris = jnp.asarray(take["priorities"]).reshape(
-                self.dp, self._stage_chunk)
-            with self._state_lock:
-                self.state = self.learner.add(self.state, items, pris)
-            with self._lock:
-                self._replay_filled = min(self._replay_filled + block,
-                                          self.capacity)
+            self._add_block(take, block)
         if force and self._stage_n:
-            # shutdown: a partial block cannot be shipped (static [dp, B]
-            # ingest shapes) — count it as dropped, matching the lossy-
-            # tolerant transport semantics; un-count it from frames so
-            # frames reconciles with what actually reached replay
-            self._stage_dropped += self._stage_n
-            with self._lock:
-                self._frames_total -= self._stage_n
+            if self.is_dist:
+                # a partial [dp, B] block cannot be shipped (static mesh
+                # shapes) — count it as dropped, matching the lossy-
+                # tolerant transport semantics; un-count its frames so
+                # they reconcile with what actually reached replay
+                self._stage_dropped += self._stage_n
+                with self._lock:
+                    self._frames_total -= self._stage_n
+            else:
+                # single-chip shutdown: one ragged add is fine (a single
+                # extra compile at the end of the run, not per-batch)
+                fields = {
+                    k: np.concatenate(
+                        [np.asarray(b[k]) for b in self._stage])
+                    for k in self._item_keys + ("priorities",)}
+                self._add_block(fields, self._stage_n)
             self._stage = []
             self._stage_n = 0
+
+    def _warmup(self) -> None:
+        """AOT-compile the hot jits before any thread starts.
+
+        The first train_step/train_many dispatch otherwise holds
+        _state_lock through a 20-40s XLA compile (TPU; tens of seconds
+        on a busy CPU test host), during which ingest cannot add and the
+        bounded transport queue drops most of the experience stream.
+        jit.lower(...).compile() populates the call cache without
+        executing — donation markers don't consume the live state.
+        """
+        learner = self.learner
+        cls = type(learner)
+        chunk = max(min(self.cfg.learner.train_chunk,
+                        self.cfg.learner.publish_every), 1)
+        if self.is_dist:
+            example = jax.tree.map(
+                lambda t: jnp.zeros((self.dp, self._stage_chunk) + t.shape,
+                                    t.dtype), self._item_spec)
+            pris = jnp.zeros((self.dp, self._stage_chunk), jnp.float32)
+        else:
+            example = jax.tree.map(
+                lambda t: jnp.zeros((self._stage_chunk,) + t.shape,
+                                    t.dtype), self._item_spec)
+            pris = jnp.zeros((self._stage_chunk,), jnp.float32)
+        cls.add.lower(learner, self.state, example, pris).compile()
+        cls.train_step.lower(learner, self.state).compile()
+        if chunk > 1:
+            cls.train_many.lower(learner, self.state, chunk).compile()
 
     def _learner_loop(self, max_grad_steps: int) -> None:
         try:
@@ -383,6 +434,9 @@ class ApexDriver:
         with self._state_lock:
             pub = self.learner.publish_params(self.state)
         self.server.update_params(pub, self._grad_steps_total)
+        # remote actor hosts pull the same copy through the transport's
+        # param channel (socket_transport serves it over DCN)
+        self.transport.publish_params(pub, self._grad_steps_total)
 
     def _learner_loop_inner(self, max_grad_steps: int) -> None:
         publish_every = self.cfg.learner.publish_every
@@ -390,12 +444,17 @@ class ApexDriver:
         chunk = max(min(self.cfg.learner.train_chunk, publish_every), 1)
         last_log = 0
         last_ckpt = self._grad_steps_total
+        cap = self.cfg.learner.steps_per_frame_cap
         while (not self.stop_event.is_set()
                and self._grad_steps_total < max_grad_steps):
             with self._lock:
                 filled = self._replay_filled
+                frames = self._frames_total
             if filled < self._min_fill():
                 time.sleep(0.05)
+                continue
+            if cap is not None and self._grad_steps_total >= cap * frames:
+                time.sleep(0.01)  # pacing: let actors catch up
                 continue
             # fuse up to `chunk` grad-steps into one device dispatch
             # (lax.scan in learner.train_many) without overshooting the
@@ -465,6 +524,14 @@ class ApexDriver:
             wall_clock_limit_s: float | None = None) -> dict:
         total = total_env_frames or self.cfg.total_env_frames
         per_actor = total // max(self.cfg.actors.num_actors, 1)
+        try:
+            self._warmup()
+        except (AttributeError, NotImplementedError) as e:
+            # AOT lowering genuinely unavailable on this backend/learner:
+            # first dispatches compile lazily (and hold _state_lock while
+            # they do). Anything else — shape mismatches, compile OOM —
+            # is a real bug that must surface, not a degraded start.
+            self.metrics.log(0, warmup_skipped=repr(e))
         threads = [
             threading.Thread(target=self._actor_thread, args=(i, per_actor),
                              name=f"actor-{i}", daemon=True)
@@ -505,7 +572,14 @@ class ApexDriver:
                         with self._lock:
                             size = self._replay_filled
                             ingested = self._ingested_batches
-                        stuck = size < self._min_fill()
+                            frames = self._frames_total
+                        cap = self.cfg.learner.steps_per_frame_cap
+                        # no further progress possible: replay never
+                        # reached min-fill, or the pacing cap binds and
+                        # no more frames will ever arrive
+                        stuck = size < self._min_fill() or (
+                            cap is not None
+                            and self._grad_steps_total >= cap * frames)
                         if max_grad_steps >= 10**9:
                             break
                         # require stuck on two consecutive polls with no

@@ -1,0 +1,160 @@
+"""CLI entry point: launch any of the five configs from a shell.
+
+SURVEY.md §1 layer 7 / §2.1: the reference ships per-config training
+entry points; here one CLI selects a preset and overrides any field:
+
+    python -m ape_x_dqn_tpu.runtime.train --config pong --actors 8 \
+        --total-env-frames 1000000 --metrics-file run.jsonl
+    python -m ape_x_dqn_tpu.runtime.train --config cartpole_smoke \
+        --single-process --set learner.lr=5e-4
+
+`--listen HOST:PORT` additionally accepts remote actor hosts
+(runtime/actor_host.py) over the socket transport while local actors
+(if any) keep running — the single-machine and multi-host topologies
+share this entry point.
+
+Prints one summary JSON line on stdout when the run ends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import sys
+from typing import Any
+
+from ape_x_dqn_tpu.configs import PRESETS, RunConfig, get_config
+from ape_x_dqn_tpu.utils.metrics import Metrics
+
+
+def _coerce(value: str, ref: Any) -> Any:
+    """Parse a CLI string against the type of the value it replaces."""
+    if value.lower() in ("none", "null"):
+        return None  # optional fields can be cleared from the CLI
+    if isinstance(ref, bool):  # before int: bool is an int subclass
+        if value.lower() in ("1", "true", "yes", "on"):
+            return True
+        if value.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"not a bool: {value!r}")
+    if isinstance(ref, int):
+        return int(value)
+    if isinstance(ref, float):
+        return float(value)
+    if isinstance(ref, tuple):
+        parsed = ast.literal_eval(value)
+        return tuple(parsed) if isinstance(parsed, (list, tuple)) \
+            else (parsed,)
+    if ref is None:
+        # the current value carries no type (e.g. `float | None` fields
+        # like learner.steps_per_frame_cap): parse the literal itself, so
+        # `--set learner.steps_per_frame_cap=1.0` lands as a float and
+        # not the string '1.0' (which the learner loop would crash on)
+        try:
+            return ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            return value
+    return value  # str fields
+
+
+def _set_dotted(cfg: Any, path: list[str], value: str) -> Any:
+    field_names = {f.name for f in dataclasses.fields(cfg)}
+    head = path[0]
+    if head not in field_names:
+        raise KeyError(
+            f"unknown config field {head!r}; known: {sorted(field_names)}")
+    current = getattr(cfg, head)
+    if len(path) == 1:
+        return dataclasses.replace(cfg, **{head: _coerce(value, current)})
+    return dataclasses.replace(
+        cfg, **{head: _set_dotted(current, path[1:], value)})
+
+
+def apply_overrides(cfg: RunConfig, sets: list[str]) -> RunConfig:
+    """Apply 'dotted.path=value' overrides onto a (frozen) RunConfig."""
+    for item in sets:
+        if "=" not in item:
+            raise ValueError(f"--set expects key=value, got {item!r}")
+        key, value = item.split("=", 1)
+        cfg = _set_dotted(cfg, key.split("."), value)
+    return cfg
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m ape_x_dqn_tpu.runtime.train",
+        description="Train any Ape-X config on TPU.")
+    ap.add_argument("--config", required=True, choices=sorted(PRESETS),
+                    help="preset name (SURVEY.md §2.1)")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--actors", type=int, default=None,
+                    help="override actors.num_actors")
+    ap.add_argument("--total-env-frames", type=int, default=None)
+    ap.add_argument("--max-grad-steps", type=int, default=10**9)
+    ap.add_argument("--wall-clock-limit", type=float, default=None,
+                    metavar="SECONDS")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--metrics-file", default=None,
+                    help="JSONL metrics sink")
+    ap.add_argument("--single-process", action="store_true",
+                    help="config-1 style in-process loop (no threads)")
+    ap.add_argument("--listen", default=None, metavar="HOST:PORT",
+                    help="also accept remote actor hosts over TCP")
+    ap.add_argument("--set", action="append", default=[],
+                    metavar="dotted.key=value",
+                    help="override any config field, e.g. "
+                         "learner.batch_size=256 (repeatable)")
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = get_config(args.config)
+    if args.seed is not None:
+        cfg = cfg.replace(seed=args.seed)
+    if args.actors is not None:
+        cfg = cfg.replace(
+            actors=dataclasses.replace(cfg.actors, num_actors=args.actors))
+    if args.total_env_frames is not None:
+        cfg = cfg.replace(total_env_frames=args.total_env_frames)
+    if args.checkpoint_dir is not None:
+        cfg = cfg.replace(checkpoint_dir=args.checkpoint_dir)
+    cfg = apply_overrides(cfg, args.set)
+
+    metrics = Metrics(log_path=args.metrics_file)
+    if args.single_process:
+        from ape_x_dqn_tpu.runtime.single_process import train_single_process
+        out = train_single_process(cfg, metrics=metrics)
+    else:
+        from ape_x_dqn_tpu.runtime.driver import ApexDriver
+        transport = None
+        server = None
+        if args.listen:
+            from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
+            host, port = args.listen.rsplit(":", 1)
+            server = transport = SocketIngestServer(host, int(port))
+            print(f"ingest listening on {host}:{server.port}",
+                  file=sys.stderr, flush=True)
+        driver = ApexDriver(cfg, metrics=metrics, transport=transport)
+        try:
+            out = driver.run(max_grad_steps=args.max_grad_steps,
+                             wall_clock_limit_s=args.wall_clock_limit)
+        finally:
+            if server is not None:
+                server.stop()
+        # summary must stay one parseable JSON line
+        out = dict(out)
+        out["actor_errors"] = [f"{i}: {e!r}"
+                               for i, e in out["actor_errors"]]
+        out["loop_errors"] = [f"{which}: {e!r}"
+                              for which, e in out["loop_errors"]]
+    metrics.close()
+    print(json.dumps(out))
+    failed = bool(out.get("actor_errors") or out.get("loop_errors"))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,85 @@
+"""CLI entry point (runtime/train.py): override plumbing + smoke runs."""
+
+import json
+
+import pytest
+
+from ape_x_dqn_tpu.configs import get_config
+from ape_x_dqn_tpu.runtime.train import apply_overrides, main
+
+
+def test_apply_overrides_typed():
+    cfg = get_config("pong")
+    cfg = apply_overrides(cfg, [
+        "learner.batch_size=64",
+        "learner.lr=0.001",
+        "replay.kind=uniform",
+        "network.dueling=false",
+        "network.mlp_hidden=(32,16)",
+        "actors.num_actors=3",
+        "eval_every_steps=0",
+    ])
+    assert cfg.learner.batch_size == 64
+    assert cfg.learner.lr == pytest.approx(1e-3)
+    assert cfg.replay.kind == "uniform"
+    assert cfg.network.dueling is False
+    assert cfg.network.mlp_hidden == (32, 16)
+    assert cfg.actors.num_actors == 3
+    assert cfg.eval_every_steps == 0
+
+
+def test_apply_overrides_optional_fields():
+    """`float | None` fields have no reference value to coerce against;
+    the literal itself must be parsed (regression: '1.0' landed as a
+    string and the learner pacing check crashed with TypeError)."""
+    cfg = get_config("pong")
+    cfg = apply_overrides(cfg, ["learner.steps_per_frame_cap=1.0"])
+    assert cfg.learner.steps_per_frame_cap == pytest.approx(1.0)
+    assert isinstance(cfg.learner.steps_per_frame_cap, float)
+    cfg = apply_overrides(cfg, ["learner.steps_per_frame_cap=none"])
+    assert cfg.learner.steps_per_frame_cap is None
+
+
+def test_apply_overrides_rejects_unknown_field():
+    cfg = get_config("pong")
+    with pytest.raises(KeyError):
+        apply_overrides(cfg, ["learner.not_a_field=3"])
+    with pytest.raises(ValueError):
+        apply_overrides(cfg, ["learner.batch_size"])  # missing '='
+
+
+def test_cli_single_process_smoke(capsys, tmp_path):
+    rc = main([
+        "--config", "cartpole_smoke", "--single-process",
+        "--total-env-frames", "3000",
+        "--metrics-file", str(tmp_path / "m.jsonl"),
+        "--set", "replay.min_fill=200",
+        "--set", "learner.batch_size=32",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["frames"] == 3000
+    assert out["grad_steps"] > 0
+    assert (tmp_path / "m.jsonl").exists()
+
+
+def test_cli_driver_smoke(capsys):
+    rc = main([
+        "--config", "cartpole_smoke",
+        "--total-env-frames", "900",
+        "--max-grad-steps", "30",
+        "--wall-clock-limit", "120",
+        "--actors", "1",
+        "--set", "replay.kind=prioritized",
+        "--set", "replay.capacity=2048",
+        "--set", "replay.min_fill=64",
+        "--set", "learner.batch_size=32",
+        "--set", "learner.publish_every=20",
+        "--set", "inference.max_batch=8",
+        "--set", "eval_every_steps=0",
+        "--set", "eval_episodes=0",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, out
+    assert out["grad_steps"] >= 30
+    assert out["actor_errors"] == [] and out["loop_errors"] == []
