@@ -54,6 +54,13 @@ class ReplayConfig:
     alpha: float = 0.6
     beta: float = 0.4
     eps: float = 1e-6  # priority floor
+    # Storage layout for pixel configs: "flat" stores stacked obs pairs
+    # per transition; "frame_ring" stores single frames once and rebuilds
+    # stacks with a device gather at sample time — ~6-7x less HBM and
+    # ingest bandwidth (replay/frame_ring.py; SURVEY.md §7 hard part 2)
+    storage: str = "flat"  # flat | frame_ring
+    seg_transitions: int = 16  # transitions per shipped frame segment
+    segs_per_add: int = 4      # segments per ingest add dispatch
     # R2D2 sequence replay (SURVEY.md §3.4)
     seq_length: int = 80
     seq_overlap: int = 40
@@ -160,7 +167,7 @@ def _preset_pong() -> RunConfig:
         env=EnvConfig(id="PongNoFrameskip-v4", kind="atari"),
         network=NetworkConfig(kind="nature_cnn", dueling=True),
         replay=ReplayConfig(kind="prioritized", capacity=1_000_000,
-                            min_fill=20_000),
+                            min_fill=20_000, storage="frame_ring"),
         learner=LearnerConfig(batch_size=512),
         actors=ActorConfig(num_actors=8),
     )
@@ -173,7 +180,10 @@ def _preset_atari57_apex() -> RunConfig:
         total_env_frames=22_500_000_000,
         env=EnvConfig(id="atari57", kind="atari"),
         network=NetworkConfig(kind="nature_cnn", dueling=True),
-        replay=ReplayConfig(kind="prioritized", capacity=2_000_000),
+        # frame-ring storage: the attested ~2M-transition capacity only
+        # fits in HBM as single frames (~10KB/transition vs ~56KB flat)
+        replay=ReplayConfig(kind="prioritized", capacity=2_000_000,
+                            storage="frame_ring"),
         learner=LearnerConfig(batch_size=512),
         actors=ActorConfig(num_actors=256),
         parallel=ParallelConfig(dp=4, tp=2),

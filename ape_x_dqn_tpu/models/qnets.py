@@ -43,6 +43,7 @@ class MLPQNet(nn.Module):
     def __call__(self, obs: jax.Array) -> jax.Array:
         dt = dtype_of(self.compute_dtype)
         x = preprocess_obs(obs, dt)
+        x = x.reshape(x.shape[0], -1)  # flatten any multi-dim obs
         for h in self.hidden:
             x = nn.relu(nn.Dense(h, dtype=dt)(x))
         if self.dueling:

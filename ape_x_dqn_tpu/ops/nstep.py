@@ -23,6 +23,9 @@ class NStepTransition:
     discount: float      # gamma^k * (1 - terminal), k = actual steps spanned
     aux: object = None   # caller payload from the FIRST step of the window
                          # (actors stash q_t(a_t) here for initial priorities)
+    span: int = 0        # k: env steps between obs and next_obs (frame-ring
+                         # shipping reconstructs next_obs as the stack `span`
+                         # steps after obs — replay/frame_ring.py)
 
 
 class NStepBuilder:
@@ -63,7 +66,8 @@ class NStepBuilder:
         obs0, action0, _, aux0 = self._window[0]
         return NStepTransition(
             obs=obs0, action=action0, reward=ret, next_obs=next_obs,
-            discount=(self.gamma**k_span) * bootstrap, aux=aux0)
+            discount=(self.gamma**k_span) * bootstrap, aux=aux0,
+            span=k_span)
 
     def reset(self) -> None:
         self._window.clear()

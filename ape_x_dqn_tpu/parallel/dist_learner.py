@@ -107,16 +107,13 @@ class DistDQNLearner:
         keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
         rng, sk = keys[:, 0], keys[:, 1]
 
-        # per-shard stratified sampling from per-shard trees (no ICI)
+        # per-shard stratified sampling from per-shard trees (no ICI).
+        # sample_items delegates storage reconstruction to the replay —
+        # flat layouts gather rows, the frame-ring layout rebuilds stacks
+        # from single frames (replay/frame_ring.py); the size clamp keeps
+        # a sparsely-filled shard's descent off zero-priority leaves
         def shard_sample(rstate: ReplayState, key):
-            # size clamps the descent into the filled region — a shard's
-            # tree can be sparsely filled (or empty early under uneven
-            # round-robin ingest) and a zero-priority leaf would otherwise
-            # dominate the batch through its huge IS weight
-            idx, probs = sum_tree.sample(rstate.tree, key, self.b_local,
-                                         size=rstate.size)
-            items = jax.tree.map(lambda buf: buf[idx], rstate.storage)
-            return items, idx, probs
+            return self.replay.sample_items(rstate, key, self.b_local)
 
         items, idx, probs = jax.vmap(shard_sample)(state.replay, sk)
 
@@ -128,6 +125,9 @@ class DistDQNLearner:
             state.replay.size.astype(jnp.float32).sum(), 1.0)
         w = (n_global * jnp.maximum(probs / self.dp, 1e-12)
              ) ** (-self.replay.beta)
+        # dead frame-ring pad slots (prob ~0) would dominate the max-
+        # normalization; they train with weight 0 instead
+        w = w * jax.vmap(self.replay.valid_mask)(state.replay, idx)
         w = w / jnp.maximum(w.max(), 1e-12)
 
         def flat(x):

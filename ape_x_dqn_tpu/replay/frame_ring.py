@@ -1,0 +1,287 @@
+"""Frame-ring prioritized replay: single frames in HBM, stacks on demand.
+
+The flat transition layout (replay/prioritized.py) stores each n-step
+transition as two full frame stacks (obs + next_obs = 2*stack frames,
+~56KB at 84x84x4 uint8) — 8x redundant, since consecutive transitions
+share all but one frame. That redundancy caps capacity (the attested
+~2M-transition flagship replay would need ~59GB) and multiplies ingest
+bytes across the wire, host->device DMA, and HBM writes (SURVEY.md §7
+hard part 2 "ingest bandwidth"; §2.2 "Prioritized replay" capacity ~2M).
+
+TPU-native fix: store each frame ONCE and reconstruct stacks with a
+device-side gather at sample time (frames are uint8 in HBM; the gather
+rides HBM bandwidth inside the learner jit, costing nothing extra — the
+flat layout reads the same bytes, it just also *stores* them 8x).
+
+Layout. Everything is built from fixed-size SEGMENTS so every shape is
+static under jit:
+
+- An actor cuts each episode's transition stream into segments of
+  exactly B transitions (`seg_transitions`), padding the episode tail
+  with dead slots (priority 0, next_off 0).
+- Per episode it keeps a frame log P where P[0:stack] are the reset
+  observation's channels and each env step appends one new frame; the
+  step-t observation stack is then always the contiguous slice
+  P[t:t+stack] (this also captures episodic-life pseudo-resets exactly,
+  because the wrapper's stack carries over and so do the seeded
+  channels). A transition starting at step t with span m (env steps
+  between obs and bootstrap obs, ops/nstep.py) has
+      obs      = P[t     : t+stack]
+      next_obs = P[t+m   : t+m+stack],  1 <= m <= n_step.
+- A segment covering start steps [t0, t0+B) therefore needs only the
+  frames P[t0 : t0+F], F = B + n_step + stack - 1 — about (B+6)/(8B)
+  of the flat layout's bytes (~6-7x less for B=16..64).
+
+Device state reuses ReplayState: storage holds a frames ring
+[S*F, H, W] uint8 (S = capacity/B segments) plus per-transition fields
+[capacity] (action/reward/discount/next_off); `pos` counts SEGMENTS;
+the sum-tree indexes transitions. Segment k owns transition slots
+[k*B, (k+1)*B) and frame slots [k*F, (k+1)*F): eviction overwrites a
+whole segment at a time, so transition<->frame aliasing is impossible
+by construction.
+
+Dead padding slots carry tree priority 0 and are never sampled (the
+descent clamp in ops/sum_tree.py keeps float rounding off them); their
+share of capacity is <= B/(2*avg_episode_len), typically <1%. IS-weight
+N counts all filled slots including dead ones — a <=1% overestimate of
+N, well inside PER's tolerance (the beta anneal it feeds is itself a
+heuristic).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.ops import sum_tree
+from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
+
+
+def frame_segment_spec(seg_transitions: int, n_step: int,
+                       obs_shape: tuple[int, ...], obs_dtype) -> dict:
+    """Item pytree spec for ONE shipped segment (leading axis added by
+    the ingest staging, like every other item spec)."""
+    h, w, stack = obs_shape
+    f = seg_transitions + n_step + stack - 1
+    return {
+        "seg_frames": jax.ShapeDtypeStruct((f, h, w), obs_dtype),
+        "action": jax.ShapeDtypeStruct((seg_transitions,), jnp.int32),
+        "reward": jax.ShapeDtypeStruct((seg_transitions,), jnp.float32),
+        "discount": jax.ShapeDtypeStruct((seg_transitions,), jnp.float32),
+        "next_off": jax.ShapeDtypeStruct((seg_transitions,), jnp.int32),
+    }
+
+
+class FrameSegmentBuilder:
+    """Actor-side segment assembly (host numpy; one per actor env).
+
+    Call order per actor loop (runtime/actor.py):
+      on_reset(obs)            after every env.reset()
+      on_step(next_obs)        after every env.step()
+      add(action, reward, discount, span, priority)
+                               for each emitted n-step transition, in
+                               start-step order (the actor's outbox order
+                               already guarantees this)
+      take_ready() -> [segment dicts] ready to ship
+      flush()                  at shutdown: pad + emit the partial tail
+
+    on_reset flushes the open partial segment first, so segments never
+    span episodes and the frame-slice invariant above always holds.
+    """
+
+    def __init__(self, seg_transitions: int, n_step: int, stack: int):
+        self.B = seg_transitions
+        self.n = n_step
+        self.stack = stack
+        self.F = self.B + self.n + self.stack - 1
+        self._frames: list[np.ndarray] = []  # P[base:], trimmed left
+        self._base = 0          # P-index of self._frames[0]
+        self._t = 0             # next transition start step (per episode)
+        self._t0: int | None = None  # open segment's first start step
+        self._fields: list[tuple] = []
+        self._ready: list[dict] = []
+
+    def on_reset(self, obs: np.ndarray) -> None:
+        self._flush_partial()
+        # seed from ALL channels: a full reset gives the wrapper's
+        # zero-padded stack, an episodic-life pseudo-reset gives the
+        # carried-over frames — both reconstruct exactly
+        self._frames = [np.ascontiguousarray(obs[..., c])
+                        for c in range(self.stack)]
+        self._base = 0
+        self._t = 0
+        self._t0 = None
+
+    def on_step(self, next_obs: np.ndarray) -> None:
+        self._frames.append(np.ascontiguousarray(next_obs[..., -1]))
+
+    def add(self, action, reward: float, discount: float, span: int,
+            priority: float) -> None:
+        assert 1 <= span <= self.n, span
+        if self._t0 is None:
+            self._t0 = self._t
+            drop = self._t0 - self._base  # frames left of P[t0]: done with
+            if drop:
+                del self._frames[:drop]
+                self._base = self._t0
+        self._t += 1
+        self._fields.append((action, float(reward), float(discount),
+                             int(span), float(priority)))
+        if len(self._fields) == self.B:
+            self._emit()
+
+    def _emit(self) -> None:
+        s = self._t0 - self._base
+        frames = self._frames[s:s + self.F]
+        while len(frames) < self.F:      # episode ended early: repeat tail
+            frames.append(frames[-1])
+        pad = self.B - len(self._fields)
+        # dead slots: priority 0 AND next_off 0 (the replay masks the
+        # tree priority on next_off>0, so eps^alpha never leaks in)
+        fields = self._fields + [(0, 0.0, 0.0, 0, 0.0)] * pad
+        acts, rews, discs, offs, pris = zip(*fields)
+        self._ready.append({
+            "seg_frames": np.stack(frames)[None],
+            "action": np.asarray(acts, np.int32).reshape(1, self.B),
+            "reward": np.asarray(rews, np.float32).reshape(1, self.B),
+            "discount": np.asarray(discs, np.float32).reshape(1, self.B),
+            "next_off": np.asarray(offs, np.int32).reshape(1, self.B),
+            "priorities": np.asarray(pris, np.float32).reshape(1, self.B),
+        })
+        self._t0 = None
+        self._fields = []
+
+    def _flush_partial(self) -> None:
+        if self._fields:
+            self._emit()
+
+    def flush(self) -> list[dict]:
+        self._flush_partial()
+        return self.take_ready()
+
+    def take_ready(self) -> list[dict]:
+        out, self._ready = self._ready, []
+        return out
+
+
+class FrameRingReplay(PrioritizedReplay):
+    """Device-side prioritized replay over segment storage.
+
+    Subclasses PrioritizedReplay: `sample` (IS weights incl. the
+    valid_mask dead-slot zeroing) is inherited, while storage
+    construction, segment `add`, the stack-gathering `sample_items`,
+    and the dead-slot-preserving `update_priorities` are overridden —
+    so DQNLearner and DistDQNLearner use either layout unchanged. `add`
+    consumes staged segments {field: [G, ...]} with priorities [G, B]
+    instead of flat items.
+    """
+
+    def __init__(self, capacity: int, seg_transitions: int, n_step: int,
+                 obs_shape: tuple[int, ...], obs_dtype=np.uint8,
+                 alpha: float = 0.6, beta: float = 0.4, eps: float = 1e-6):
+        super().__init__(capacity=capacity, alpha=alpha, beta=beta, eps=eps)
+        assert capacity % seg_transitions == 0, \
+            "segment size must divide capacity"
+        assert len(obs_shape) == 3, \
+            f"frame-ring replay needs [H, W, stack] pixel obs, " \
+            f"got {obs_shape}"
+        self.B = seg_transitions
+        self.n = n_step
+        self.h, self.w, self.stack = obs_shape
+        self.F = self.B + self.n + self.stack - 1
+        self.S = capacity // self.B          # segment slots
+        self.obs_dtype = obs_dtype
+
+    # -- state construction ------------------------------------------------
+
+    def init(self, item_spec: Any = None) -> ReplayState:
+        """item_spec is accepted for interface parity and ignored — the
+        storage layout is fixed by the constructor arguments."""
+        storage = {
+            "frames": jnp.zeros((self.S * self.F, self.h, self.w),
+                                self.obs_dtype),
+            "action": jnp.zeros((self.capacity,), jnp.int32),
+            "reward": jnp.zeros((self.capacity,), jnp.float32),
+            "discount": jnp.zeros((self.capacity,), jnp.float32),
+            "next_off": jnp.zeros((self.capacity,), jnp.int32),
+        }
+        return ReplayState(storage=storage, tree=sum_tree.init(self.capacity),
+                           pos=jnp.int32(0), size=jnp.int32(0))
+
+    # -- transitions (pure, jit-friendly) ----------------------------------
+
+    def add(self, state: ReplayState, items: Any,
+            td_abs: jax.Array) -> ReplayState:
+        """Write G whole segments at the segment cursor.
+
+        items: {"seg_frames": [G, F, H, W], "action"/"reward"/"discount"/
+        "next_off": [G, B]}; td_abs: [G, B] initial |TD| (0 on dead pads).
+        """
+        g = td_abs.shape[0]
+        seg = (state.pos + jnp.arange(g, dtype=jnp.int32)) % self.S
+        fidx = (seg[:, None] * self.F
+                + jnp.arange(self.F, dtype=jnp.int32)[None, :]).reshape(-1)
+        tidx = (seg[:, None] * self.B
+                + jnp.arange(self.B, dtype=jnp.int32)[None, :]).reshape(-1)
+        storage = dict(state.storage)
+        storage["frames"] = state.storage["frames"].at[fidx].set(
+            items["seg_frames"].reshape(g * self.F, self.h, self.w)
+            .astype(self.obs_dtype))
+        for k in ("action", "reward", "discount", "next_off"):
+            buf = state.storage[k]
+            storage[k] = buf.at[tidx].set(
+                items[k].reshape(-1).astype(buf.dtype))
+        valid = items["next_off"].reshape(-1) > 0
+        pri = jnp.where(valid, (td_abs.reshape(-1) + self.eps) ** self.alpha,
+                        0.0)
+        tree = sum_tree.update(state.tree, tidx, pri)
+        return ReplayState(
+            storage=storage, tree=tree,
+            pos=(state.pos + g) % self.S,
+            size=jnp.minimum(state.size + g * self.B, self.capacity))
+
+    def _gather(self, state: ReplayState, idx: jax.Array) -> dict:
+        """Reconstruct flat transitions {obs, action, reward, next_obs,
+        discount} for transition indices idx [Bt] — the stack gather."""
+        st = state.storage
+        seg, j = idx // self.B, idx % self.B
+        base = seg * self.F + j
+        offs = jnp.arange(self.stack, dtype=jnp.int32)[None, :]
+        obs_f = st["frames"][base[:, None] + offs]          # [Bt,stack,H,W]
+        nbase = base + st["next_off"][idx]                  # dead: off 0 —
+        next_f = st["frames"][nbase[:, None] + offs]        # never sampled
+        to_hwc = lambda f: jnp.moveaxis(f, 1, -1)           # -> [Bt,H,W,st]
+        return {
+            "obs": to_hwc(obs_f),
+            "action": st["action"][idx],
+            "reward": st["reward"][idx],
+            "next_obs": to_hwc(next_f),
+            "discount": st["discount"][idx],
+        }
+
+    def sample_items(self, state: ReplayState, rng: jax.Array, batch: int
+                     ) -> tuple[Any, jax.Array, jax.Array]:
+        """-> (flat transition batch, leaf indices [B], probs [B])."""
+        idx, probs = sum_tree.sample(state.tree, rng, batch,
+                                     size=state.size)
+        return self._gather(state, idx), idx, probs
+
+    # sample() is inherited: PrioritizedReplay.sample composes
+    # sample_items (overridden above) with IS weights and the
+    # valid_mask dead-slot zeroing (overridden below).
+
+    def update_priorities(self, state: ReplayState, idx: jax.Array,
+                          td_abs: jax.Array) -> ReplayState:
+        pri = (td_abs + self.eps) ** self.alpha
+        # a dead slot must stay dead: a clamp-landed draw would otherwise
+        # write (garbage-TD)^alpha here and resurrect it into the
+        # sampling distribution permanently
+        pri = jnp.where(state.storage["next_off"][idx] > 0, pri, 0.0)
+        return state._replace(tree=sum_tree.update(state.tree, idx, pri))
+
+    def valid_mask(self, state: ReplayState, idx: jax.Array) -> jax.Array:
+        """[B] f32: 1 on live transitions, 0 on dead pad slots."""
+        return (state.storage["next_off"][idx] > 0).astype(jnp.float32)

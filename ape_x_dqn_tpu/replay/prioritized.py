@@ -76,14 +76,29 @@ class PrioritizedReplay:
             pos=(state.pos + b) % self.capacity,
             size=jnp.minimum(state.size + b, self.capacity))
 
-    def sample(self, state: ReplayState, rng: jax.Array, batch: int
-               ) -> tuple[Any, jax.Array, jax.Array]:
-        """-> (item batch pytree, leaf indices [B], IS weights [B])."""
+    def sample_items(self, state: ReplayState, rng: jax.Array, batch: int
+                     ) -> tuple[Any, jax.Array, jax.Array]:
+        """-> (item batch pytree, leaf indices [B], probs [B]) without IS
+        weights — the dist learner computes those globally across shards
+        (parallel/dist_learner.py), and FrameRingReplay shares the
+        calling convention."""
         idx, probs = sum_tree.sample(state.tree, rng, batch,
                                      size=state.size)
         items = jax.tree.map(lambda buf: buf[idx], state.storage)
+        return items, idx, probs
+
+    def sample(self, state: ReplayState, rng: jax.Array, batch: int
+               ) -> tuple[Any, jax.Array, jax.Array]:
+        """-> (item batch pytree, leaf indices [B], IS weights [B]).
+
+        valid_mask zeroes the weight of storage layouts' dead slots
+        BEFORE max-normalization (a ~zero-probability dead draw would
+        otherwise become the max and crush every live weight); for flat
+        storage it is all-ones and folds away."""
+        items, idx, probs = self.sample_items(state, rng, batch)
         n = jnp.maximum(state.size.astype(jnp.float32), 1.0)
         w = (n * jnp.maximum(probs, 1e-12)) ** (-self.beta)
+        w = w * self.valid_mask(state, idx)
         w = w / jnp.maximum(w.max(), 1e-12)
         return items, idx, w
 
@@ -91,6 +106,11 @@ class PrioritizedReplay:
                           td_abs: jax.Array) -> ReplayState:
         pri = (td_abs + self.eps) ** self.alpha
         return state._replace(tree=sum_tree.update(state.tree, idx, pri))
+
+    def valid_mask(self, state: ReplayState, idx: jax.Array) -> jax.Array:
+        """[B] f32: 1 where idx is trainable. Flat storage has no dead
+        slots; the frame-ring layout overrides this (pad slots)."""
+        return jnp.ones(idx.shape, jnp.float32)
 
     # -- convenience jitted endpoints (standalone use / replay server) -----
 

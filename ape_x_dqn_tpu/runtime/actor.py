@@ -25,6 +25,7 @@ import numpy as np
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
+from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
 from ape_x_dqn_tpu.replay.sequence import (
     SequenceBuilder, split_priorities, stack_items)
 
@@ -66,6 +67,16 @@ class Actor:
         self._frames_unshipped = 0
         self._outbox: list[tuple[NStepTransition, float]] = []
         self._pending: list[NStepTransition] = []
+        # frame-ring shipping (replay/frame_ring.py): transitions leave as
+        # fixed segments of single frames instead of stacked obs pairs
+        self._seg: FrameSegmentBuilder | None = None
+        if getattr(cfg.replay, "storage", "flat") == "frame_ring":
+            spec = self.env.spec
+            assert spec.discrete and len(spec.obs_shape) == 3, \
+                "frame_ring storage needs discrete [H, W, stack] pixel envs"
+            self._seg = FrameSegmentBuilder(
+                cfg.replay.seg_transitions, cfg.learner.n_step,
+                stack=spec.obs_shape[-1])
 
     # -- policy hooks (overridden by ContinuousActor) ----------------------
 
@@ -85,11 +96,21 @@ class Actor:
 
     # -- priority resolution ----------------------------------------------
 
+    def _queue(self, t: NStepTransition, priority: float) -> None:
+        """A transition's initial priority is resolved: hand it to the
+        shipping pipeline. Callers always queue in start-step order (the
+        pending list drains before any newer transition routes), which
+        the frame-segment builder relies on."""
+        if self._seg is not None:
+            self._seg.add(t.action, t.reward, t.discount, t.span, priority)
+        else:
+            self._outbox.append((t, priority))
+
     def _resolve_pending(self, out) -> None:
         v_next = self._bootstrap_value(out)
         for t in self._pending:
             target = t.reward + t.discount * v_next
-            self._outbox.append((t, abs(target - float(t.aux))))
+            self._queue(t, abs(target - float(t.aux)))
         self._pending.clear()
 
     def _route(self, transitions: list[NStepTransition],
@@ -97,18 +118,30 @@ class Actor:
         v_term: float | None = None
         for t in transitions:
             if t.discount == 0.0:
-                self._outbox.append((t, abs(t.reward - float(t.aux))))
+                self._queue(t, abs(t.reward - float(t.aux)))
             elif terminal_obs is not None:
                 # truncation flush: the bootstrap obs won't be queried
                 # again, ask the server once for its value
                 if v_term is None:
                     v_term = self._bootstrap_value(self.query(terminal_obs))
                 target = t.reward + t.discount * v_term
-                self._outbox.append((t, abs(target - float(t.aux))))
+                self._queue(t, abs(target - float(t.aux)))
             else:
                 self._pending.append(t)
 
+    def _ship_segments(self, force: bool = False) -> None:
+        segs = self._seg.flush() if force else self._seg.take_ready()
+        for seg in segs:
+            seg["actor"] = self.index
+            # env-frame accounting rides the first segment of the batch
+            seg["frames"] = self._frames_unshipped
+            self._frames_unshipped = 0
+            self.transport.send_experience(seg)
+
     def _ship(self, force: bool = False) -> None:
+        if self._seg is not None:
+            self._ship_segments(force)
+            return
         if not self._outbox:
             return
         if not force and len(self._outbox) < self.cfg.actors.ingest_batch:
@@ -134,6 +167,8 @@ class Actor:
     def run(self, max_frames: int,
             stop_event: threading.Event | None = None) -> int:
         obs = self.env.reset()
+        if self._seg is not None:
+            self._seg.on_reset(obs)
         while self.frames < max_frames and not (
                 stop_event is not None and stop_event.is_set()):
             out = self.query(obs)
@@ -142,6 +177,8 @@ class Actor:
             next_obs, reward, done, info = self.env.step(action)
             self.frames += 1
             self._frames_unshipped += 1
+            if self._seg is not None:
+                self._seg.on_step(next_obs)
             terminal = info.get("terminal", done)
             truncated = done and not terminal
             new_ts = self.nstep.append(obs, action, reward, next_obs,
@@ -150,6 +187,10 @@ class Actor:
             self._route(new_ts, terminal_obs=next_obs if truncated else None)
             if done:
                 obs = self.env.reset()
+                if self._seg is not None:
+                    # flushes the open partial segment first: segments
+                    # never span episodes
+                    self._seg.on_reset(obs)
                 if self.episode_callback and "episode_return" in info:
                     self.episode_callback(self.index, info)
             else:

@@ -32,6 +32,8 @@ from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
+from ape_x_dqn_tpu.replay.frame_ring import (
+    FrameRingReplay, frame_segment_spec)
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
 from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
 from ape_x_dqn_tpu.runtime.actor import (
@@ -88,6 +90,15 @@ class ApexDriver:
                                    obs0[None])
             item_spec = transition_item_spec(self.spec.obs_shape,
                                              self.spec.obs_dtype)
+        self._frame_mode = cfg.replay.storage == "frame_ring"
+        if self._frame_mode:
+            if self.family != "dqn" or cfg.replay.kind != "prioritized":
+                raise NotImplementedError(
+                    "frame_ring storage covers the prioritized flat-DQN "
+                    "family (pixel envs); use storage='flat' otherwise")
+            item_spec = frame_segment_spec(
+                cfg.replay.seg_transitions, cfg.learner.n_step,
+                self.spec.obs_shape, self.spec.obs_dtype)
         self._item_keys = tuple(item_spec.keys())
         self.dp = cfg.parallel.dp
         self.is_dist = cfg.parallel.dp * cfg.parallel.tp > 1
@@ -104,9 +115,7 @@ class ApexDriver:
                 "distributed learner requires prioritized replay"
             self.mesh = make_mesh(dp=cfg.parallel.dp, tp=cfg.parallel.tp)
             shard_cap = next_pow2(max(cfg.replay.capacity // self.dp, 2))
-            self.replay = PrioritizedReplay(
-                capacity=shard_cap, alpha=cfg.replay.alpha,
-                beta=cfg.replay.beta, eps=cfg.replay.eps)
+            self.replay = self._build_prioritized(shard_cap)
             self.learner = DistDQNLearner(self.net.apply, self.replay,
                                           cfg.learner, self.mesh)
             self.state = self.learner.init(
@@ -117,7 +126,9 @@ class ApexDriver:
             # device through the warm-up phase (no host round-trip)
             server_params = self.learner.publish_params(self.state)
         else:
-            self.replay = build_replay(cfg.replay)
+            self.replay = (self._build_prioritized(
+                               next_pow2(cfg.replay.capacity))
+                           if self._frame_mode else build_replay(cfg.replay))
             lkey = component_key(cfg.seed, "learner")
             if self.family == "r2d2":
                 self.learner = SequenceLearner(
@@ -170,14 +181,21 @@ class ApexDriver:
         # blocks on a device->host read of state.replay.size (round-1
         # verdict "weak" #4: that sync serialized every iteration)
         self._replay_filled = 0
-        # ingest staging: transitions accumulate host-side until a full
+        # ingest staging: staging units accumulate host-side until a full
         # fixed-size block ships to the device in one add — [dp, chunk]
         # on the mesh, [chunk] single-chip. Fixed block shapes matter:
         # actors ship ragged batch sizes, and every distinct size would
-        # compile a fresh add graph (20-40s each on TPU).
+        # compile a fresh add graph (20-40s each on TPU). A staging unit
+        # is one transition (flat storage) or one whole frame segment of
+        # seg_transitions transitions (frame-ring storage).
         self._stage: list[dict] = []
         self._stage_n = 0
-        self._stage_chunk = max(cfg.actors.ingest_batch, 1)
+        if self._frame_mode:
+            self._stage_chunk = max(cfg.replay.segs_per_add, 1)
+            self._unit_items = cfg.replay.seg_transitions
+        else:
+            self._stage_chunk = max(cfg.actors.ingest_batch, 1)
+            self._unit_items = 1
         self._stage_dropped = 0
         self._item_spec = item_spec
         self.last_eval: dict | None = None
@@ -188,6 +206,19 @@ class ApexDriver:
                      if cfg.checkpoint_dir else None)
         if self.ckpt is not None:
             self._maybe_restore()
+
+    def _build_prioritized(self, capacity: int):
+        """Prioritized replay at `capacity` (single-chip total or per-dp
+        shard) in the configured storage layout."""
+        r = self.cfg.replay
+        if self._frame_mode:
+            return FrameRingReplay(
+                capacity=capacity, seg_transitions=r.seg_transitions,
+                n_step=self.cfg.learner.n_step,
+                obs_shape=self.spec.obs_shape, obs_dtype=self.spec.obs_dtype,
+                alpha=r.alpha, beta=r.beta, eps=r.eps)
+        return PrioritizedReplay(capacity=capacity, alpha=r.alpha,
+                                 beta=r.beta, eps=r.eps)
 
     # -- checkpoint / resume ----------------------------------------------
 
@@ -337,13 +368,14 @@ class ApexDriver:
             self._ingested_batches += 1
 
     def _add_block(self, take: dict, count: int) -> None:
+        """count is in staging units; priorities reshape like items (they
+        carry a trailing [seg_transitions] axis in frame-ring mode)."""
         if self.is_dist:
-            items = {
-                k: jnp.asarray(v).reshape(self.dp, self._stage_chunk,
-                                          *v.shape[1:])
-                for k, v in take.items() if k != "priorities"}
-            pris = jnp.asarray(take["priorities"]).reshape(
-                self.dp, self._stage_chunk)
+            shard = lambda v: jnp.asarray(v).reshape(
+                self.dp, self._stage_chunk, *v.shape[1:])
+            items = {k: shard(v) for k, v in take.items()
+                     if k != "priorities"}
+            pris = shard(take["priorities"])
         else:
             items = {k: jnp.asarray(v) for k, v in take.items()
                      if k != "priorities"}
@@ -351,8 +383,9 @@ class ApexDriver:
         with self._state_lock:
             self.state = self.learner.add(self.state, items, pris)
         with self._lock:
-            self._replay_filled = min(self._replay_filled + count,
-                                      self.capacity)
+            self._replay_filled = min(
+                self._replay_filled + count * self._unit_items,
+                self.capacity)
 
     def _flush_stage(self, force: bool = False) -> None:
         """Ship staged transitions to the learner in fixed-size blocks —
@@ -374,11 +407,21 @@ class ApexDriver:
             if self.is_dist:
                 # a partial [dp, B] block cannot be shipped (static mesh
                 # shapes) — count it as dropped, matching the lossy-
-                # tolerant transport semantics; un-count its frames so
-                # they reconcile with what actually reached replay
-                self._stage_dropped += self._stage_n
-                with self._lock:
-                    self._frames_total -= self._stage_n
+                # tolerant transport semantics
+                if self._frame_mode:
+                    # count LIVE transitions (segments carry dead episode-
+                    # tail pads), and leave _frames_total alone: env-frame
+                    # counts ride ingest messages separately in frame mode
+                    # and those frames were genuinely consumed
+                    self._stage_dropped += int(sum(
+                        (np.asarray(b["next_off"]) > 0).sum()
+                        for b in self._stage))
+                else:
+                    # flat mode: 1 unit = 1 env frame, keep the frames
+                    # counter reconciled with what actually reached replay
+                    self._stage_dropped += self._stage_n
+                    with self._lock:
+                        self._frames_total -= self._stage_n
             else:
                 # single-chip shutdown: one ragged add is fine (a single
                 # extra compile at the end of the run, not per-batch)
@@ -404,16 +447,21 @@ class ApexDriver:
         cls = type(learner)
         chunk = max(min(self.cfg.learner.train_chunk,
                         self.cfg.learner.publish_every), 1)
+        # priorities carry a trailing [seg_transitions] axis per staged
+        # frame segment; flat staging units are single transitions
+        ptail = (self.cfg.replay.seg_transitions,) if self._frame_mode \
+            else ()
         if self.is_dist:
             example = jax.tree.map(
                 lambda t: jnp.zeros((self.dp, self._stage_chunk) + t.shape,
                                     t.dtype), self._item_spec)
-            pris = jnp.zeros((self.dp, self._stage_chunk), jnp.float32)
+            pris = jnp.zeros((self.dp, self._stage_chunk) + ptail,
+                             jnp.float32)
         else:
             example = jax.tree.map(
                 lambda t: jnp.zeros((self._stage_chunk,) + t.shape,
                                     t.dtype), self._item_spec)
-            pris = jnp.zeros((self._stage_chunk,), jnp.float32)
+            pris = jnp.zeros((self._stage_chunk,) + ptail, jnp.float32)
         cls.add.lower(learner, self.state, example, pris).compile()
         cls.train_step.lower(learner, self.state).compile()
         if chunk > 1:

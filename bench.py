@@ -33,10 +33,11 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_learner(capacity: int, batch_size: int):
+def build_learner(capacity: int, batch_size: int, storage: str):
     from ape_x_dqn_tpu.configs import LearnerConfig, NetworkConfig
     from ape_x_dqn_tpu.envs.base import EnvSpec
     from ape_x_dqn_tpu.models import build_network
+    from ape_x_dqn_tpu.replay.frame_ring import FrameRingReplay
     from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
     from ape_x_dqn_tpu.runtime.learner import (DQNLearner,
                                                transition_item_spec)
@@ -48,36 +49,82 @@ def build_learner(capacity: int, batch_size: int):
     params = net.init(component_key(0, "net_init"),
                       jnp.zeros((1, 84, 84, 4), jnp.uint8))
     lcfg = LearnerConfig(batch_size=batch_size)
-    replay = PrioritizedReplay(capacity=capacity)
+    if storage == "frame_ring":
+        replay = FrameRingReplay(capacity=capacity, seg_transitions=16,
+                                 n_step=3, obs_shape=spec.obs_shape)
+        replay_state = replay.init()
+    else:
+        replay = PrioritizedReplay(capacity=capacity)
+        replay_state = replay.init(transition_item_spec(spec.obs_shape,
+                                                        spec.obs_dtype))
     learner = DQNLearner(net.apply, replay, lcfg)
-    state = learner.init(
-        params, replay.init(transition_item_spec(spec.obs_shape,
-                                                 spec.obs_dtype)),
-        component_key(0, "learner"))
+    state = learner.init(params, replay_state, component_key(0, "learner"))
     return net, learner, state, spec
 
 
-def prefill(learner, state, spec, n_items: int, chunk: int = 4096):
-    """Fill replay with synthetic transitions via the real `add` jit."""
+def _flat_chunk(spec, chunk: int, rng) -> tuple[dict, object]:
+    items = {
+        "obs": jnp.asarray(
+            rng.integers(0, 255, (chunk, *spec.obs_shape)), jnp.uint8),
+        "action": jnp.asarray(
+            rng.integers(0, spec.num_actions, chunk), jnp.int32),
+        "reward": jnp.asarray(rng.normal(size=chunk), jnp.float32),
+        "next_obs": jnp.asarray(
+            rng.integers(0, 255, (chunk, *spec.obs_shape)), jnp.uint8),
+        "discount": jnp.full(chunk, 0.99**3, jnp.float32),
+    }
+    return items, jnp.asarray(rng.uniform(0.1, 2.0, chunk), jnp.float32)
+
+
+def _seg_chunk(replay, spec, g: int, rng) -> tuple[dict, object]:
+    b, f = replay.B, replay.F
+    items = {
+        "seg_frames": jnp.asarray(
+            rng.integers(0, 255, (g, f, *spec.obs_shape[:2])), jnp.uint8),
+        "action": jnp.asarray(
+            rng.integers(0, spec.num_actions, (g, b)), jnp.int32),
+        "reward": jnp.asarray(rng.normal(size=(g, b)), jnp.float32),
+        "discount": jnp.full((g, b), 0.99**3, jnp.float32),
+        "next_off": jnp.full((g, b), 3, jnp.int32),
+    }
+    return items, jnp.asarray(rng.uniform(0.1, 2.0, (g, b)), jnp.float32)
+
+
+def prefill(learner, state, spec, n_items: int, storage: str,
+            chunk: int = 4096):
+    """Fill replay via the real `add` jit, and time the INGEST PATH
+    separately from host data generation: one chunk of synthetic
+    transitions is generated once, and every dispatch re-lands it from
+    host memory (host->device DMA + add), which is what actor ingest
+    actually costs the learner host."""
+    replay = learner.replay
     rng = np.random.default_rng(0)
+    if storage == "frame_ring":
+        g = chunk // replay.B
+        dev_items, dev_pris = _seg_chunk(replay, spec, g, rng)
+        n_dispatch = n_items // (g * replay.B)
+        per_dispatch = g * replay.B
+        wire_bytes = sum(np.asarray(v).nbytes for v in dev_items.values())
+    else:
+        dev_items, dev_pris = _flat_chunk(spec, chunk, rng)
+        n_dispatch = n_items // chunk
+        per_dispatch = chunk
+        wire_bytes = sum(np.asarray(v).nbytes for v in dev_items.values())
+    host_items = {k: np.asarray(v) for k, v in dev_items.items()}
+    host_pris = np.asarray(dev_pris)
+    # compile once
+    state = learner.add(state, dev_items, dev_pris)
+    jax.block_until_ready(state.replay.tree)
     t0 = time.monotonic()
-    for _ in range(n_items // chunk):
-        items = {
-            "obs": jnp.asarray(
-                rng.integers(0, 255, (chunk, *spec.obs_shape)), jnp.uint8),
-            "action": jnp.asarray(
-                rng.integers(0, spec.num_actions, chunk), jnp.int32),
-            "reward": jnp.asarray(rng.normal(size=chunk), jnp.float32),
-            "next_obs": jnp.asarray(
-                rng.integers(0, 255, (chunk, *spec.obs_shape)), jnp.uint8),
-            "discount": jnp.full(chunk, 0.99**3, jnp.float32),
-        }
-        pris = jnp.asarray(rng.uniform(0.1, 2.0, chunk), jnp.float32)
-        state = learner.add(state, items, pris)
+    for _ in range(max(n_dispatch - 1, 1)):
+        items = {k: jnp.asarray(v) for k, v in host_items.items()}
+        state = learner.add(state, items, jnp.asarray(host_pris))
     jax.block_until_ready(state.replay.tree)
     dt = time.monotonic() - t0
-    log(f"prefill: {n_items} transitions in {dt:.1f}s "
-        f"({n_items / dt:,.0f} items/s ingest)")
+    n_done = max(n_dispatch - 1, 1) * per_dispatch
+    log(f"ingest (h2d + add): {n_done / dt:,.0f} items/s, "
+        f"{wire_bytes / per_dispatch:,.0f} wire bytes/item "
+        f"[{storage}]")
     return state
 
 
@@ -114,18 +161,23 @@ def bench_inference(net, spec, batch: int = 64, iters: int = 50) -> float:
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--capacity", type=int, default=1 << 16,
-                   help="replay capacity (stacked-frame storage: "
-                   "~56KB HBM per transition)")
+    p.add_argument("--capacity", type=int, default=1 << 18,
+                   help="replay capacity in transitions (frame-ring "
+                   "storage: ~10KB HBM per transition; flat: ~56KB)")
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--prefill", type=int, default=1 << 15)
     p.add_argument("--steps-per-dispatch", type=int, default=50)
     p.add_argument("--dispatches", type=int, default=10)
+    p.add_argument("--storage", choices=("frame_ring", "flat"),
+                   default="frame_ring",
+                   help="replay layout; frame_ring is the flagship "
+                   "(replay/frame_ring.py)")
     args = p.parse_args()
 
     log(f"devices: {jax.devices()}")
-    net, learner, state, spec = build_learner(args.capacity, args.batch_size)
-    state = prefill(learner, state, spec, args.prefill)
+    net, learner, state, spec = build_learner(args.capacity, args.batch_size,
+                                              args.storage)
+    state = prefill(learner, state, spec, args.prefill, args.storage)
 
     gsps, state = bench_learner(learner, state, args.steps_per_dispatch,
                                 args.dispatches)

@@ -135,9 +135,10 @@ def test_apex_driver_end_to_end():
     # killed an actor and this test still passed)
     assert out["actor_errors"] == [], out["actor_errors"]
     # train_many chunks reach the grad-step target fast, so the run can
-    # end well before actors produce many frames; min_fill (64) plus at
-    # least one shipped batch is what the wiring actually guarantees
-    assert out["frames"] >= 80, out
+    # end well before actors produce many frames; min_fill (64) is all
+    # the wiring guarantees — under full-suite CPU contention the
+    # learner can finish its 50 steps before actors ship another block
+    assert out["frames"] >= 64, out
     assert out["grad_steps"] >= 50, out
     assert out["episodes"] > 0
     assert out["server"]["items"] > 0
