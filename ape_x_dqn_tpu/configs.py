@@ -106,6 +106,11 @@ class ActorConfig:
     eps_alpha: float = 7.0
     ingest_batch: int = 50  # transitions buffered before shipping
     param_pull_every: int = 400  # env steps between parameter pulls
+    # Elastic recovery (SURVEY.md §5): a crashed actor is rebuilt (fresh
+    # env + n-step state) and resumes its remaining frame budget, up to
+    # this many times per actor slot; Ape-X tolerates actor loss, so a
+    # restart costs only the crashed actor's in-flight transitions
+    max_restarts: int = 2
     # continuous-control exploration noise stddev (DPG)
     noise_sigma: float = 0.2
 
@@ -139,6 +144,12 @@ class RunConfig:
     eval_eps: float = 0.001
     checkpoint_dir: str = ""
     checkpoint_every: int = 50_000
+    # JAX profiler capture (SURVEY.md §5 tracing/profiling): when set,
+    # the driver traces `profile_steps` learner grad-steps starting at
+    # the first dispatch after min-fill into this directory
+    # (TensorBoard/Perfetto-readable)
+    profile_dir: str = ""
+    profile_steps: int = 24
 
     def replace(self, **kw: Any) -> "RunConfig":
         return dataclasses.replace(self, **kw)

@@ -68,6 +68,25 @@ class BatchedInferenceServer:
             raise req.result
         return req.result
 
+    def warmup(self, example_input: Any) -> None:
+        """AOT-compile the batched forward at bucket sizes 1 and
+        max_batch before actors start querying. On TPU the first compile
+        takes 10-40s — longer than a reasonable query timeout — so an
+        unwarmed server's first trickle of batch-1 queries times actors
+        out (observed live: actor restart on 'inference server did not
+        reply' during startup). Intermediate pow2 buckets still compile
+        on first use, inside the 30s query timeout.
+
+        example_input: one request pytree WITHOUT the batch dim (content
+        irrelevant; only shapes/dtypes feed the compile cache)."""
+        with self._lock:
+            params = self._params
+        for b in sorted({1, next_pow2(self._max_batch)}):
+            stacked = jax.tree.map(
+                lambda x: np.zeros((b, *np.asarray(x).shape),
+                                   np.asarray(x).dtype), example_input)
+            self._apply.lower(params, stacked).compile()
+
     # -- learner side ------------------------------------------------------
 
     def update_params(self, params: Any, version: int) -> None:

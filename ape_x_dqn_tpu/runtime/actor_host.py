@@ -16,8 +16,11 @@ Entry points:
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
+
+import numpy as np
 
 from ape_x_dqn_tpu.comm.socket_transport import SocketTransport
 from ape_x_dqn_tpu.configs import RunConfig
@@ -69,6 +72,15 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         max_batch=cfg.inference.max_batch,
         deadline_ms=cfg.inference.deadline_ms)
     server.update_params(params, version)
+    try:  # pre-compile the forward so first queries don't time out
+        server.warmup(
+            np.zeros(probe.spec.obs_shape, probe.spec.obs_dtype))
+    except (AttributeError, NotImplementedError):
+        # AOT lowering unavailable on this backend: compile lazily on
+        # first query. Anything else (shape mismatch, compile OOM) is a
+        # real bug that must surface, not a silent degraded start.
+        print("actor_host: AOT warmup unavailable; first query compiles "
+              "lazily", file=sys.stderr, flush=True)
 
     def param_puller() -> None:
         while not stop_event.wait(param_poll_s):

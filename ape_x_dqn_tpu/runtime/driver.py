@@ -175,6 +175,7 @@ class ApexDriver:
         self._lock = threading.Lock()
         self._state_lock = threading.Lock()
         self.actor_errors: list[tuple[int, Exception]] = []
+        self.actor_restarts: list[tuple[int, str]] = []  # recovered crashes
         self.loop_errors: list[tuple[str, Exception]] = []  # ingest/learner
         self._ingested_batches = 0
         # host-side mirror of replay fill so the learner hot loop never
@@ -198,6 +199,10 @@ class ApexDriver:
             self._unit_items = 1
         self._stage_dropped = 0
         self._item_spec = item_spec
+        # profiler capture state: False = armed, True = tracing,
+        # None = finished/disabled (single capture per run)
+        self._profiling: bool | None = False if cfg.profile_dir else None
+        self._profile_from = 0
         self.last_eval: dict | None = None
         # checkpoint/resume (SURVEY.md §5): params/targets/opt/rng/step are
         # saved; replay contents are not (large, and Ape-X tolerates
@@ -324,15 +329,49 @@ class ApexDriver:
             self.episode_returns.append(float(info["episode_return"]))
 
     def _actor_thread(self, i: int, max_frames: int) -> None:
-        try:
-            actor_cls = {"r2d2": RecurrentActor,
-                         "dpg": ContinuousActor}.get(self.family, Actor)
-            actor = actor_cls(self.cfg, i, self.server.query, self.transport,
-                              episode_callback=self._on_episode)
-            actor.run(max_frames, self.stop_event)  # frames counted at ingest
-        except Exception as e:
-            with self._lock:
-                self.actor_errors.append((i, e))
+        """Supervised actor slot: on a crash the actor is rebuilt (fresh
+        env, n-step state, transport handle stay) and resumes the
+        REMAINING frame budget, up to actors.max_restarts times —
+        SURVEY.md §5 elastic recovery (actors are stateless-ish data
+        producers; losing one's in-flight transitions is harmless).
+        Exhausting the budget records the error, which fails the run
+        report (actor_errors)."""
+        actor_cls = {"r2d2": RecurrentActor,
+                     "dpg": ContinuousActor}.get(self.family, Actor)
+        remaining = max_frames
+        restarts_left = self.cfg.actors.max_restarts
+        attempt = 0
+        while remaining > 0 and not self.stop_event.is_set():
+            actor = None
+            try:
+                # salt the seed per attempt: an unsalted rebuild replays
+                # the exact env + eps-greedy sequence already ingested —
+                # re-shipping duplicate experience, and re-triggering any
+                # trajectory-dependent crash until the budget burns out
+                seed = (self.cfg.seed if attempt == 0
+                        else self.cfg.seed + 7907 * attempt)
+                actor = actor_cls(self.cfg, i, self.server.query,
+                                  self.transport, seed=seed,
+                                  episode_callback=self._on_episode)
+                actor.run(remaining, self.stop_event)
+                return  # frames counted at ingest
+            except Exception as e:
+                # frames the crashed actor already ingested stay counted;
+                # only its unshipped tail is lost
+                remaining -= actor.frames if actor is not None else 0
+                # a crash with no budget left (frames or restarts) is an
+                # error, not a "recovered" restart — e.g. the final
+                # force-ship failing after all frames were stepped
+                if (restarts_left <= 0 or remaining <= 0
+                        or self.stop_event.is_set()):
+                    with self._lock:
+                        self.actor_errors.append((i, e))
+                    return
+                restarts_left -= 1
+                attempt += 1
+                with self._lock:
+                    self.actor_restarts.append((i, repr(e)))
+                self.metrics.log(self._grad_steps_total, actor_restart=i)
 
     def _min_fill(self) -> int:
         return min(self.cfg.replay.min_fill, self.capacity // 2)
@@ -466,6 +505,14 @@ class ApexDriver:
         cls.train_step.lower(learner, self.state).compile()
         if chunk > 1:
             cls.train_many.lower(learner, self.state, chunk).compile()
+        # the inference server's first forward compile otherwise exceeds
+        # the actor query timeout on TPU (observed live)
+        obs = np.zeros(self.spec.obs_shape, self.spec.obs_dtype)
+        if self.family == "r2d2":
+            z = np.zeros(self.cfg.network.lstm_size, np.float32)
+            self.server.warmup({"obs": obs, "c": z, "h": z})
+        else:
+            self.server.warmup(obs)
 
     def _learner_loop(self, max_grad_steps: int) -> None:
         try:
@@ -473,6 +520,12 @@ class ApexDriver:
         except Exception as e:
             with self._lock:
                 self.loop_errors.append(("learner", e))
+        finally:
+            # an exception mid-capture must still flush the trace (and
+            # release the process-wide profiler for any later run)
+            if self._profiling:
+                jax.profiler.stop_trace()
+                self._profiling = None
 
     def _publish_params(self) -> None:
         # copy/reshard under the state lock: a concurrent add() or
@@ -485,6 +538,26 @@ class ApexDriver:
         # remote actor hosts pull the same copy through the transport's
         # param channel (socket_transport serves it over DCN)
         self.transport.publish_params(pub, self._grad_steps_total)
+
+    def _maybe_profile(self) -> None:
+        """Trace the first profile_steps learner dispatches after min-fill
+        (SURVEY.md §5 tracing): start/stop bracket the real hot loop —
+        train_many dispatches, ingest adds racing them, publish copies —
+        so the capture shows the actual interleaving, not a synthetic
+        microbenchmark. Called only once the loop is about to dispatch
+        (the min-fill/pacing `continue`s above the call site gate it)."""
+        if not self.cfg.profile_dir or self._profiling is None:
+            return
+        if not self._profiling:
+            jax.profiler.start_trace(self.cfg.profile_dir)
+            self._profile_from = self._grad_steps_total
+            self._profiling = True
+        elif self._profiling and (self._grad_steps_total - self._profile_from
+                                  >= self.cfg.profile_steps):
+            jax.profiler.stop_trace()
+            self._profiling = None  # done: never restart
+            self.metrics.log(self._grad_steps_total,
+                             profile_trace=self.cfg.profile_dir)
 
     def _learner_loop_inner(self, max_grad_steps: int) -> None:
         publish_every = self.cfg.learner.publish_every
@@ -504,6 +577,7 @@ class ApexDriver:
             if cap is not None and self._grad_steps_total >= cap * frames:
                 time.sleep(0.01)  # pacing: let actors catch up
                 continue
+            self._maybe_profile()
             # fuse up to `chunk` grad-steps into one device dispatch
             # (lax.scan in learner.train_many) without overshooting the
             # step target or a publish boundary; k is snapped to {chunk, 1}
@@ -540,6 +614,8 @@ class ApexDriver:
                     avg_return=avg_ret,
                     replay_size=replay_size,
                     ingest_dropped=self.transport.dropped)
+        # NOTE: a capture still open here (short run ending inside the
+        # profile window) is closed by _learner_loop's finally
 
     def _eval_loop(self) -> None:
         """Greedy-eval at every eval_every_steps grad-step boundary
@@ -681,6 +757,7 @@ class ApexDriver:
             "server": self.server.stats,
             "ingest_dropped": self.transport.dropped + self._stage_dropped,
             "actor_errors": list(self.actor_errors),
+            "actor_restarts": list(self.actor_restarts),
             "loop_errors": list(self.loop_errors),
             "eval": self.last_eval,
         }

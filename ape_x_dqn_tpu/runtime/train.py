@@ -96,6 +96,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--wall-clock-limit", type=float, default=None,
                     metavar="SECONDS")
     ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture a JAX profiler trace of the learner "
+                         "hot loop into this directory")
     ap.add_argument("--metrics-file", default=None,
                     help="JSONL metrics sink")
     ap.add_argument("--single-process", action="store_true",
@@ -121,6 +124,8 @@ def main(argv: list[str] | None = None) -> int:
         cfg = cfg.replace(total_env_frames=args.total_env_frames)
     if args.checkpoint_dir is not None:
         cfg = cfg.replace(checkpoint_dir=args.checkpoint_dir)
+    if args.profile_dir is not None:
+        cfg = cfg.replace(profile_dir=args.profile_dir)
     cfg = apply_overrides(cfg, args.set)
 
     metrics = Metrics(log_path=args.metrics_file)

@@ -129,17 +129,26 @@ def prefill(learner, state, spec, n_items: int, storage: str,
 
 
 def bench_learner(learner, state, steps_per_dispatch: int,
-                  dispatches: int) -> tuple[float, object]:
-    # compile + warmup dispatch (excluded from timing)
+                  dispatches: int,
+                  trace_dir: str | None = None) -> tuple[float, object]:
+    # compile + warmup dispatch (excluded from timing AND the trace —
+    # a 20-40s compile window would drown the steady-state capture)
     t0 = time.monotonic()
     state, m = learner.train_many(state, steps_per_dispatch)
     jax.block_until_ready(m["loss"])
     log(f"train_many compile+first dispatch: {time.monotonic() - t0:.1f}s "
         f"(loss={float(m['loss']):.4f})")
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     t0 = time.monotonic()
-    for _ in range(dispatches):
-        state, m = learner.train_many(state, steps_per_dispatch)
-    jax.block_until_ready(m["loss"])
+    try:
+        for _ in range(dispatches):
+            state, m = learner.train_many(state, steps_per_dispatch)
+        jax.block_until_ready(m["loss"])
+    finally:
+        if trace_dir:
+            jax.profiler.stop_trace()
+            log(f"profiler trace written to {trace_dir}")
     dt = time.monotonic() - t0
     assert np.isfinite(float(m["loss"])), "non-finite loss in steady state"
     return (steps_per_dispatch * dispatches) / dt, state
@@ -172,6 +181,9 @@ def main() -> None:
                    default="frame_ring",
                    help="replay layout; frame_ring is the flagship "
                    "(replay/frame_ring.py)")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a JAX profiler trace of the timed "
+                   "train_many dispatches into DIR")
     args = p.parse_args()
 
     log(f"devices: {jax.devices()}")
@@ -180,7 +192,7 @@ def main() -> None:
     state = prefill(learner, state, spec, args.prefill, args.storage)
 
     gsps, state = bench_learner(learner, state, args.steps_per_dispatch,
-                                args.dispatches)
+                                args.dispatches, trace_dir=args.profile)
     log(f"learner: {gsps:.1f} grad-steps/s @ batch {args.batch_size} "
         f"= {gsps * args.batch_size:,.0f} samples/s "
         f"(capacity {args.capacity})")

@@ -172,6 +172,64 @@ def test_apex_dist_driver_end_to_end():
     assert sizes.shape == (4,) and (sizes > 0).all(), sizes
 
 
+class _FlakyActor(Actor):
+    """Crashes the first actor-0 run; behaves normally after."""
+
+    crashed: dict = {}
+
+    def run(self, max_frames, stop_event=None):
+        if self.index == 0 and not _FlakyActor.crashed.get("done"):
+            _FlakyActor.crashed["done"] = True
+            raise RuntimeError("injected actor crash")
+        return super().run(max_frames, stop_event)
+
+
+def test_actor_crash_recovery(monkeypatch):
+    """SURVEY.md §5 elastic recovery: a crashed in-driver actor is
+    rebuilt and the run completes with no actor_errors."""
+    _FlakyActor.crashed = {}
+    monkeypatch.setattr("ape_x_dqn_tpu.runtime.driver.Actor", _FlakyActor)
+    cfg = _tiny_cfg(num_actors=2)
+    driver = ApexDriver(cfg)
+    out = driver.run(total_env_frames=1200, max_grad_steps=50,
+                     wall_clock_limit_s=120)
+    assert _FlakyActor.crashed.get("done")
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert [i for i, _ in out["actor_restarts"]] == [0], out
+    assert out["grad_steps"] >= 50, out
+
+
+def test_actor_crash_exhausts_restart_budget(monkeypatch):
+    """max_restarts=0: the crash surfaces as an actor error instead of
+    recovering (the failure is not silently retried forever)."""
+    _FlakyActor.crashed = {}
+    monkeypatch.setattr("ape_x_dqn_tpu.runtime.driver.Actor", _FlakyActor)
+    cfg = _tiny_cfg(num_actors=2)
+    cfg = cfg.replace(actors=ActorConfig(
+        num_actors=2, base_eps=0.6, ingest_batch=16, max_restarts=0))
+    driver = ApexDriver(cfg)
+    out = driver.run(total_env_frames=600, max_grad_steps=30,
+                     wall_clock_limit_s=120)
+    assert [i for i, _ in out["actor_errors"]] == [0], out
+    assert out["actor_restarts"] == []
+
+
+def test_profile_trace_capture(tmp_path):
+    """SURVEY.md §5 tracing: profile_dir captures a JAX profiler trace
+    of the learner hot loop."""
+    import os
+    cfg = _tiny_cfg(num_actors=1).replace(
+        profile_dir=str(tmp_path / "trace"), profile_steps=8)
+    driver = ApexDriver(cfg)
+    out = driver.run(total_env_frames=900, max_grad_steps=30,
+                     wall_clock_limit_s=120)
+    assert out["loop_errors"] == [], out["loop_errors"]
+    assert out["grad_steps"] >= 30
+    trace_files = [os.path.join(r, f)
+                   for r, _, fs in os.walk(tmp_path / "trace") for f in fs]
+    assert trace_files, "no profiler trace written"
+
+
 def test_apex_driver_shuts_down_when_learner_cannot_progress():
     """Actors finish before replay reaches min_fill + finite grad-step
     target: run() must return instead of spinning forever."""
