@@ -1,4 +1,4 @@
-"""Multi-chip learner: replay-sharded data parallelism + tensor-parallel
+"""Multi-chip learners: replay-sharded data parallelism + tensor-parallel
 dense layers over a (dp, tp) mesh.
 
 Reference parity (SURVEY.md §2.3): the reference's NCCL grad all-reduce
@@ -23,7 +23,13 @@ collectives" recipe):
   param shardings by initializing it under jit with sharded inputs.
 
 Ingest expects items pre-split per shard: [dp, B_ingest, ...]. The
-host-side driver round-robins actor transitions across shards.
+host-side driver round-robins actor staging units across shards.
+
+Two concrete learners share the machinery via _DistLearnerBase:
+DistDQNLearner (flat n-step transitions, SURVEY.md §3.3) and
+DistSequenceLearner (R2D2 stored-state sequences, §3.4 — the r2d2
+config attests dp=4 x tp=2). They differ only in the loss and how
+sampled items become a loss batch.
 """
 
 from __future__ import annotations
@@ -37,8 +43,10 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ape_x_dqn_tpu.ops import sum_tree
-from ape_x_dqn_tpu.ops.losses import TransitionBatch, make_dqn_loss
+from ape_x_dqn_tpu.ops.losses import (
+    TransitionBatch, make_dqn_loss, make_r2d2_loss)
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
+from ape_x_dqn_tpu.replay.sequence import batch_to_sequence_batch
 from ape_x_dqn_tpu.parallel.sharding import make_param_shardings
 from ape_x_dqn_tpu.runtime.learner import make_optimizer
 
@@ -52,12 +60,13 @@ class DistTrainState(NamedTuple):
     step: jax.Array       # scalar int32
 
 
-class DistDQNLearner:
-    def __init__(self, net_apply: Callable, replay: PrioritizedReplay,
-                 lcfg, mesh: Mesh,
+class _DistLearnerBase:
+    """Shared (dp, tp) machinery; subclasses set self.loss_fn and
+    override _make_batch(flattened items) -> loss batch."""
+
+    def __init__(self, replay: PrioritizedReplay, lcfg, mesh: Mesh,
                  optimizer: optax.GradientTransformation | None = None):
         """`replay` is configured with the PER-SHARD capacity."""
-        self.net_apply = net_apply
         self.replay = replay
         self.lcfg = lcfg
         self.mesh = mesh
@@ -66,11 +75,11 @@ class DistDQNLearner:
             "batch_size must divide by dp"
         self.b_local = lcfg.batch_size // self.dp
         self.optimizer = optimizer or make_optimizer(lcfg)
-        self.loss_fn = make_dqn_loss(
-            net_apply, double=lcfg.double_dqn, huber_delta=lcfg.huber_delta,
-            rescale=lcfg.value_rescale)
         self._dp_sharding = NamedSharding(mesh, P("dp"))
         self._repl_sharding = NamedSharding(mesh, P())
+
+    def _make_batch(self, items: Any) -> Any:
+        raise NotImplementedError
 
     # -- state construction ------------------------------------------------
 
@@ -135,10 +144,7 @@ class DistDQNLearner:
             return jax.lax.with_sharding_constraint(
                 y, self._dp_sharding)
 
-        batch = TransitionBatch(
-            obs=flat(items["obs"]), actions=flat(items["action"]),
-            rewards=flat(items["reward"]), next_obs=flat(items["next_obs"]),
-            discounts=flat(items["discount"]))
+        batch = self._make_batch(jax.tree.map(flat, items))
         (loss, aux), grads = jax.value_and_grad(
             self.loss_fn, has_aux=True)(
             state.params, state.target_params, batch, flat(w))
@@ -205,3 +211,48 @@ class DistDQNLearner:
         """
         repl = jax.device_put(state.params, self._repl_sharding)
         return jax.tree.map(jnp.copy, repl)
+
+
+class DistDQNLearner(_DistLearnerBase):
+    """Flat n-step double-DQN over the mesh (SURVEY.md §3.3)."""
+
+    def __init__(self, net_apply: Callable, replay: PrioritizedReplay,
+                 lcfg, mesh: Mesh,
+                 optimizer: optax.GradientTransformation | None = None):
+        super().__init__(replay, lcfg, mesh, optimizer)
+        self.net_apply = net_apply
+        self.loss_fn = make_dqn_loss(
+            net_apply, double=lcfg.double_dqn, huber_delta=lcfg.huber_delta,
+            rescale=lcfg.value_rescale)
+
+    def _make_batch(self, items: Any) -> TransitionBatch:
+        return TransitionBatch(
+            obs=items["obs"], actions=items["action"],
+            rewards=items["reward"], next_obs=items["next_obs"],
+            discounts=items["discount"])
+
+
+class DistSequenceLearner(_DistLearnerBase):
+    """R2D2 stored-state sequences over the mesh (SURVEY.md §3.4; the
+    r2d2 config attests dp=4 x tp=2).
+
+    Replay shards hold whole sequences as items (same per-shard trees);
+    the burn-in unroll + n-step sequence loss runs on the flattened
+    [dp*b_local] sequence batch — the LSTM time axis stays unsharded
+    (SURVEY.md §5 long-context: shard the batch axis, scan the time
+    axis), and the per-SEQUENCE eta-mixed |TD| writes back per shard.
+    """
+
+    def __init__(self, net_apply_seq: Callable, replay: PrioritizedReplay,
+                 lcfg, rcfg, mesh: Mesh,
+                 optimizer: optax.GradientTransformation | None = None):
+        super().__init__(replay, lcfg, mesh, optimizer)
+        self.net_apply_seq = net_apply_seq
+        self.loss_fn = make_r2d2_loss(
+            net_apply_seq, burn_in=rcfg.burn_in, n_step=lcfg.n_step,
+            gamma=lcfg.gamma, huber_delta=lcfg.huber_delta,
+            double=lcfg.double_dqn, rescale=lcfg.value_rescale,
+            priority_eta=rcfg.priority_eta)
+
+    def _make_batch(self, items: Any):
+        return batch_to_sequence_batch(items)

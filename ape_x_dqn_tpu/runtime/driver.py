@@ -29,7 +29,8 @@ from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
-from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
+from ape_x_dqn_tpu.parallel.dist_learner import (
+    DistDQNLearner, DistSequenceLearner)
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
 from ape_x_dqn_tpu.replay.frame_ring import (
@@ -102,22 +103,30 @@ class ApexDriver:
         self._item_keys = tuple(item_spec.keys())
         self.dp = cfg.parallel.dp
         self.is_dist = cfg.parallel.dp * cfg.parallel.tp > 1
-        if self.is_dist and self.family != "dqn":
+        if self.is_dist and self.family == "dpg":
             raise NotImplementedError(
-                "distributed learner currently covers the DQN family; "
-                "run r2d2/dpg with parallel dp=tp=1")
+                "the distributed learner covers the DQN and R2D2 "
+                "families; DPG nets are small — run dp=tp=1")
         if self.is_dist:
             # Multi-chip learner (SURVEY.md §7 step 7): replay shards +
             # batch shards + gradient psum over the (dp, tp) mesh; ingest
-            # round-robins actor transitions across the dp replay shards
-            # (dist_learner.py ingest contract: items arrive [dp, B, ...]).
-            assert cfg.replay.kind == "prioritized", \
-                "distributed learner requires prioritized replay"
+            # round-robins actor staging units across the dp replay
+            # shards (dist_learner.py contract: items arrive [dp, B, ...]).
+            # R2D2's "sequence" replay is the same prioritized machinery
+            # with whole sequences as items.
+            assert cfg.replay.kind in ("prioritized", "sequence"), \
+                "distributed learner requires prioritized replay " \
+                "(kind='prioritized', or kind='sequence' for R2D2)"
             self.mesh = make_mesh(dp=cfg.parallel.dp, tp=cfg.parallel.tp)
             shard_cap = next_pow2(max(cfg.replay.capacity // self.dp, 2))
             self.replay = self._build_prioritized(shard_cap)
-            self.learner = DistDQNLearner(self.net.apply, self.replay,
-                                          cfg.learner, self.mesh)
+            if self.family == "r2d2":
+                self.learner = DistSequenceLearner(
+                    lambda p, o, s: self.net.apply(p, o, s),
+                    self.replay, cfg.learner, cfg.replay, self.mesh)
+            else:
+                self.learner = DistDQNLearner(self.net.apply, self.replay,
+                                              cfg.learner, self.mesh)
             self.state = self.learner.init(
                 params, item_spec, component_key(cfg.seed, "learner"))
             self.capacity = shard_cap * self.dp
@@ -194,6 +203,16 @@ class ApexDriver:
         if self._frame_mode:
             self._stage_chunk = max(cfg.replay.segs_per_add, 1)
             self._unit_items = cfg.replay.seg_transitions
+        elif self.family == "r2d2":
+            # staging units are whole sequences; ingest_batch counts
+            # TRANSITIONS, so a sequence chunk must scale down by the
+            # sequence length (the actor ships in the same group size) —
+            # otherwise a [dp, ingest_batch] block of SEQUENCES holds
+            # dp*ingest_batch*seq_length env steps and the learner
+            # starves waiting for the first add
+            self._stage_chunk = max(
+                cfg.actors.ingest_batch // cfg.replay.seq_length, 1)
+            self._unit_items = 1
         else:
             self._stage_chunk = max(cfg.actors.ingest_batch, 1)
             self._unit_items = 1
@@ -455,6 +474,14 @@ class ApexDriver:
                     self._stage_dropped += int(sum(
                         (np.asarray(b["next_off"]) > 0).sum()
                         for b in self._stage))
+                elif self.family == "r2d2":
+                    # units are sequences; env frames also ride ingest
+                    # messages separately here, so _frames_total stays.
+                    # The drop stat is transition-denominated: seq_length
+                    # per sequence (an upper bound — overlapping
+                    # sequences double-count their shared steps)
+                    self._stage_dropped += (self._stage_n
+                                            * self.cfg.replay.seq_length)
                 else:
                     # flat mode: 1 unit = 1 env frame, keep the frames
                     # counter reconciled with what actually reached replay

@@ -154,6 +154,28 @@ def test_r2d2_driver_end_to_end():
     assert out["eval"] is not None and out["eval"]["episodes"] > 0
 
 
+def test_r2d2_dist_driver_end_to_end():
+    """Distributed R2D2 (SURVEY.md §2.1 config 4 attests dp=4 x tp=2):
+    sequence-replay shards + LSTM sequence loss over the virtual
+    8-device mesh, sequence round-robin ingest, replicated publication."""
+    from ape_x_dqn_tpu.parallel.dist_learner import DistSequenceLearner
+
+    cfg = _r2d2_cfg(num_actors=2).replace(
+        parallel=ParallelConfig(dp=4, tp=2))
+    driver = ApexDriver(cfg)
+    assert driver.is_dist and driver.family == "r2d2"
+    assert isinstance(driver.learner, DistSequenceLearner)
+    out = driver.run(total_env_frames=2500, max_grad_steps=40,
+                     wall_clock_limit_s=240)
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert out["loop_errors"] == [], out["loop_errors"]
+    assert out["grad_steps"] >= 40, out
+    assert driver.server.params_version > 0
+    # every dp shard of the sequence replay received sequences
+    sizes = np.asarray(driver.state.replay.size)
+    assert sizes.shape == (4,) and (sizes > 0).all(), sizes
+
+
 import pytest  # noqa: E402
 
 
