@@ -20,14 +20,13 @@ import sys
 import threading
 import time
 
-import numpy as np
-
 from ape_x_dqn_tpu.comm.socket_transport import SocketTransport
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
-from ape_x_dqn_tpu.runtime.actor import Actor
+from ape_x_dqn_tpu.runtime.family import (
+    actor_class, family_of, server_apply_fn, warmup_example)
 
 
 def run_actor_host(cfg: RunConfig, host: str, port: int,
@@ -43,14 +42,6 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     schedule (host k of m runs indices [k*n, (k+1)*n) of num_actors*m).
     """
     n = num_actors or cfg.actors.num_actors
-    if cfg.network.kind in ("lstm_q", "dpg"):
-        # the host's inference path below is the flat-DQN forward; the
-        # recurrent (r2d2) and continuous (dpg) actor families need their
-        # stateful/tuple server protocols (driver.py _server_apply_fn)
-        # plumbed through before remote hosts can run them
-        raise NotImplementedError(
-            f"actor_host supports the flat-DQN family; network kind "
-            f"{cfg.network.kind!r} requires the in-driver actor runtime")
     stop_event = stop_event or threading.Event()
     transport = SocketTransport(host, port)
 
@@ -67,14 +58,17 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
 
     probe = make_env(cfg.env, seed=cfg.seed)
     net = build_network(cfg.network, probe.spec)
+    # family dispatch shared with the driver (runtime/family.py): the
+    # server protocol, actor class, and warmup example must all match
+    # what the learner host's published params expect
+    family = family_of(cfg)
     server = BatchedInferenceServer(
-        lambda p, obs: net.apply(p, obs), params,
+        server_apply_fn(family, net), params,
         max_batch=cfg.inference.max_batch,
         deadline_ms=cfg.inference.deadline_ms)
     server.update_params(params, version)
     try:  # pre-compile the forward so first queries don't time out
-        server.warmup(
-            np.zeros(probe.spec.obs_shape, probe.spec.obs_dtype))
+        server.warmup(warmup_example(family, cfg, probe.spec))
     except (AttributeError, NotImplementedError):
         # AOT lowering unavailable on this backend: compile lazily on
         # first query. Anything else (shape mismatch, compile OOM) is a
@@ -100,7 +94,7 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     def actor_thread(slot: int) -> None:
         idx = actor_offset + slot
         try:
-            actor = Actor(cfg, idx, server.query, transport)
+            actor = actor_class(family)(cfg, idx, server.query, transport)
             frames[slot] = actor.run(per_actor, stop_event)
         except Exception as e:  # noqa: BLE001 - reported to caller
             errors.append((idx, e))

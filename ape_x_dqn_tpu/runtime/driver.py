@@ -37,8 +37,8 @@ from ape_x_dqn_tpu.replay.frame_ring import (
     FrameRingReplay, frame_segment_spec)
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
 from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
-from ape_x_dqn_tpu.runtime.actor import (
-    Actor, ContinuousActor, RecurrentActor)
+from ape_x_dqn_tpu.runtime.family import (
+    actor_class, family_of, server_apply_fn, warmup_example)
 from ape_x_dqn_tpu.runtime.dpg_learner import (
     DPGLearner, continuous_item_spec)
 from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
@@ -66,8 +66,7 @@ class ApexDriver:
         obs0 = probe_env.reset()
         # model family: flat-transition DQN, stored-state sequences (R2D2),
         # or continuous-control actor-critic (Ape-X DPG)
-        self.family = {"lstm_q": "r2d2", "dpg": "dpg"}.get(
-            cfg.network.kind, "dqn")
+        self.family = family_of(cfg)
         if self.family == "r2d2":
             z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
             params = self.net.init(component_key(cfg.seed, "net_init"),
@@ -296,23 +295,8 @@ class ApexDriver:
     # -- components --------------------------------------------------------
 
     def _server_apply_fn(self):
-        """The batched forward the inference server jits, per family."""
-        if self.family == "r2d2":
-            def apply_rec(p, inp):
-                q, (c, h) = self.net.apply(p, inp["obs"],
-                                           (inp["c"], inp["h"]),
-                                           method=self.net.step)
-                return {"q": q, "c": c, "h": h}
-            return apply_rec
-        if self.family == "dpg":
-            actor_net, critic_net = self.net
-
-            def apply_dpg(p, obs):
-                a = actor_net.apply(p["actor"], obs)
-                q = critic_net.apply(p["critic"], obs, a)
-                return {"a": a, "q": q}
-            return apply_dpg
-        return lambda p, obs: self.net.apply(p, obs)
+        """The batched forward the inference server jits (family.py)."""
+        return server_apply_fn(self.family, self.net)
 
     def _make_eval_policy(self):
         """Per-episode policy factory for the eval worker: recurrent
@@ -355,8 +339,7 @@ class ApexDriver:
         producers; losing one's in-flight transitions is harmless).
         Exhausting the budget records the error, which fails the run
         report (actor_errors)."""
-        actor_cls = {"r2d2": RecurrentActor,
-                     "dpg": ContinuousActor}.get(self.family, Actor)
+        actor_cls = actor_class(self.family)
         remaining = max_frames
         restarts_left = self.cfg.actors.max_restarts
         attempt = 0
@@ -534,12 +517,7 @@ class ApexDriver:
             cls.train_many.lower(learner, self.state, chunk).compile()
         # the inference server's first forward compile otherwise exceeds
         # the actor query timeout on TPU (observed live)
-        obs = np.zeros(self.spec.obs_shape, self.spec.obs_dtype)
-        if self.family == "r2d2":
-            z = np.zeros(self.cfg.network.lstm_size, np.float32)
-            self.server.warmup({"obs": obs, "c": z, "h": z})
-        else:
-            self.server.warmup(obs)
+        self.server.warmup(warmup_example(self.family, self.cfg, self.spec))
 
     def _learner_loop(self, max_grad_steps: int) -> None:
         try:

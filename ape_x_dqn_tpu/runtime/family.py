@@ -1,0 +1,64 @@
+"""Model-family dispatch shared by the driver and remote actor hosts.
+
+A RunConfig's network kind selects one of three runtime families —
+flat-DQN ("dqn"), recurrent R2D2 ("r2d2"), continuous Ape-X DPG
+("dpg") — which differ in the inference-server protocol (plain Q-values
+vs stateful {obs,c,h} vs {a,q} actor-critic), the actor class, and the
+AOT-warmup example. ApexDriver (runtime/driver.py) and run_actor_host
+(runtime/actor_host.py) must agree on all three, so the dispatch lives
+here once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.runtime.actor import (
+    Actor, ContinuousActor, RecurrentActor)
+
+
+def family_of(cfg: RunConfig) -> str:
+    return {"lstm_q": "r2d2", "dpg": "dpg"}.get(cfg.network.kind, "dqn")
+
+
+def actor_class(family: str) -> type[Actor]:
+    return {"r2d2": RecurrentActor, "dpg": ContinuousActor}.get(
+        family, Actor)
+
+
+def server_apply_fn(family: str, net: Any) -> Callable:
+    """The batched forward the inference server jits, per family.
+
+    - dqn:  obs [B, ...]          -> q [B, A]
+    - r2d2: {obs, c, h}           -> {q, c, h}   (stateful step)
+    - dpg:  obs [B, ...]          -> {a: mu(s), q: Q(s, mu(s))}
+      (params are the {actor, critic} dict publish_params produces)
+    """
+    if family == "r2d2":
+        def apply_rec(p, inp):
+            q, (c, h) = net.apply(p, inp["obs"], (inp["c"], inp["h"]),
+                                  method=net.step)
+            return {"q": q, "c": c, "h": h}
+        return apply_rec
+    if family == "dpg":
+        actor_net, critic_net = net
+
+        def apply_dpg(p, obs):
+            a = actor_net.apply(p["actor"], obs)
+            q = critic_net.apply(p["critic"], obs, a)
+            return {"a": a, "q": q}
+        return apply_dpg
+    return lambda p, obs: net.apply(p, obs)
+
+
+def warmup_example(family: str, cfg: RunConfig, spec: Any) -> Any:
+    """One server request pytree (no batch dim) for AOT warmup —
+    shapes/dtypes only, content irrelevant."""
+    obs = np.zeros(spec.obs_shape, spec.obs_dtype)
+    if family == "r2d2":
+        z = np.zeros(cfg.network.lstm_size, np.float32)
+        return {"obs": obs, "c": z, "h": z}
+    return obs

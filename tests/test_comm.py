@@ -2,6 +2,7 @@
 remote actor hosts, and actor-loss fault injection (SURVEY.md §2.3 item
 3 "gRPC -> DCN ingest", §5 "failure detection")."""
 
+import dataclasses
 import os
 import signal
 import subprocess
@@ -171,12 +172,92 @@ def test_two_process_training_over_tcp():
         server.stop()
 
 
-def test_actor_host_rejects_non_dqn_families():
-    """The host's inference path is the flat-DQN forward; r2d2/dpg
-    configs must fail fast, not die obscurely in a server thread."""
+_R2D2_SETS = [
+    "env.kind=cartpole_po", "env.id=CartPolePO",
+    "network.lstm_size=32", "network.torso_dense=64",
+    "network.compute_dtype=float32",
+    "replay.capacity=512", "replay.seq_length=16", "replay.seq_overlap=8",
+    "replay.burn_in=4", "replay.min_fill=24",
+    "learner.batch_size=16", "learner.publish_every=20",
+    "learner.train_chunk=4",
+    "actors.ingest_batch=64", "inference.max_batch=8",
+    "inference.deadline_ms=1.0",
+    "parallel.dp=1", "parallel.tp=1",
+    "eval_every_steps=0", "eval_episodes=0",
+]
+
+
+def test_two_process_r2d2_training_over_tcp():
+    """A remote RECURRENT actor host feeds stored-state sequences over
+    the socket transport (runtime/family.py dispatch shared with the
+    driver); the sequence learner trains on the combined stream."""
+    from ape_x_dqn_tpu.runtime.train import apply_overrides
+
+    cfg = apply_overrides(get_config("r2d2"), _R2D2_SETS)
+    cfg = cfg.replace(actors=dataclasses.replace(cfg.actors, num_actors=1))
+    server = SocketIngestServer("127.0.0.1", 0)
+    driver = ApexDriver(cfg, transport=server)  # publishes params v0
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ape_x_dqn_tpu.runtime.actor_host",
+         "--config", "r2d2", "--connect", f"127.0.0.1:{server.port}",
+         "--actors", "1", "--actor-offset", "1",
+         "--frames-per-actor", "400"]
+        + [a for s in _R2D2_SETS for a in ("--set", s)],
+        env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=180)
+        assert proc.returncode == 0, stderr[-2000:]
+        assert "'errors': []" in stdout
+        assert server.pending > 0, "remote sequences never reached the queue"
+        out = driver.run(total_env_frames=2000, max_grad_steps=10**9,
+                         wall_clock_limit_s=240)
+        assert out["actor_errors"] == [], out["actor_errors"]
+        assert out["loop_errors"] == [], out["loop_errors"]
+        assert out["grad_steps"] > 0, out
+        # the remote host's 400 frames arrived on top of the local 2000
+        assert out["frames"] > 2100, out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        server.stop()
+
+
+def test_dpg_remote_actor_host_ships_continuous_experience():
+    """The DPG family over the remote-host path (runtime/family.py):
+    {actor, critic} params distribute through the transport's pickle
+    channel, the host's server evaluates {a: mu(s), q: Q(s, mu(s))},
+    and ContinuousActor ships float-action transitions."""
+    from ape_x_dqn_tpu.configs import get_config as _get
     from ape_x_dqn_tpu.runtime.actor_host import run_actor_host
-    with pytest.raises(NotImplementedError):
-        run_actor_host(get_config("apex_dpg"), "127.0.0.1", 1)
+    from ape_x_dqn_tpu.runtime.driver import ApexDriver as _Driver
+
+    cfg = _get("apex_dpg").replace(
+        env=dataclasses.replace(_get("apex_dpg").env,
+                                id="pendulum", kind="control"),
+        actors=ActorConfig(num_actors=1, ingest_batch=16,
+                           noise_sigma=0.15),
+        inference=InferenceConfig(max_batch=4, deadline_ms=1.0),
+        eval_every_steps=0, eval_episodes=0,
+    )
+    server = SocketIngestServer("127.0.0.1", 0)
+    driver = _Driver(cfg, transport=server)  # publishes dpg params v0
+    try:
+        out = run_actor_host(cfg, "127.0.0.1", server.port, num_actors=1,
+                             actor_offset=1, frames_per_actor=120)
+        assert out["errors"] == [], out["errors"]
+        assert out["frames"] == 120
+        assert out["last_param_version"] >= 0
+        got = server.recv_experience(timeout=5.0)
+        assert got is not None
+        assert got["action"].dtype == np.float32  # continuous actions
+        assert got["action"].ndim == 2            # [B, action_dim]
+        assert (got["priorities"] >= 0).all()
+    finally:
+        driver.server.stop()
+        server.stop()
 
 
 def test_actor_loss_fault_injection():

@@ -188,7 +188,7 @@ def test_actor_crash_recovery(monkeypatch):
     """SURVEY.md §5 elastic recovery: a crashed in-driver actor is
     rebuilt and the run completes with no actor_errors."""
     _FlakyActor.crashed = {}
-    monkeypatch.setattr("ape_x_dqn_tpu.runtime.driver.Actor", _FlakyActor)
+    monkeypatch.setattr("ape_x_dqn_tpu.runtime.family.Actor", _FlakyActor)
     cfg = _tiny_cfg(num_actors=2)
     driver = ApexDriver(cfg)
     out = driver.run(total_env_frames=1200, max_grad_steps=50,
@@ -203,7 +203,7 @@ def test_actor_crash_exhausts_restart_budget(monkeypatch):
     """max_restarts=0: the crash surfaces as an actor error instead of
     recovering (the failure is not silently retried forever)."""
     _FlakyActor.crashed = {}
-    monkeypatch.setattr("ape_x_dqn_tpu.runtime.driver.Actor", _FlakyActor)
+    monkeypatch.setattr("ape_x_dqn_tpu.runtime.family.Actor", _FlakyActor)
     cfg = _tiny_cfg(num_actors=2)
     cfg = cfg.replace(actors=ActorConfig(
         num_actors=2, base_eps=0.6, ingest_batch=16, max_restarts=0))
