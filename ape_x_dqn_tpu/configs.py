@@ -208,9 +208,12 @@ def _preset_r2d2() -> RunConfig:
         total_env_frames=10_000_000_000,
         env=EnvConfig(id="atari57", kind="atari"),
         network=NetworkConfig(kind="lstm_q", dueling=True),
+        # frame_ring: sequences store single frames (~0.6MB each at
+        # L=80) instead of per-step stacks (~2.2MB) — the difference
+        # between this capacity fitting across the dp shards or not
         replay=ReplayConfig(kind="sequence", capacity=100_000,  # sequences
                             seq_length=80, seq_overlap=40, burn_in=40,
-                            min_fill=5_000),
+                            min_fill=5_000, storage="frame_ring"),
         learner=LearnerConfig(batch_size=64, n_step=5, value_rescale=True,
                               target_sync_every=2500, lr=1e-4),
         actors=ActorConfig(num_actors=256),

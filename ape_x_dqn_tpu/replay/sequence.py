@@ -19,12 +19,30 @@ import numpy as np
 
 
 def sequence_item_spec(obs_shape: tuple[int, ...], obs_dtype,
-                       seq_len: int, lstm_size: int) -> dict:
-    """ShapeDtypeStruct-style pytree describing ONE stored sequence."""
+                       seq_len: int, lstm_size: int,
+                       frame_mode: bool = False) -> dict:
+    """ShapeDtypeStruct-style pytree describing ONE stored sequence.
+
+    frame_mode (pixel obs only): store single frames
+    [seq_len + stack - 1, H, W] instead of per-step stacks
+    [seq_len, H, W, stack] — consecutive steps share all but one frame,
+    so stacked storage is ~stack x redundant (~4x at Atari shapes; the
+    attested 100k-sequence capacity only fits in HBM without it).
+    Stacks are rebuilt by `batch_to_sequence_batch` with `stack` cheap
+    slices inside the learner jit.
+    """
     import jax
     f32 = np.float32
+    if frame_mode:
+        h, w, stack = obs_shape
+        obs_sds = jax.ShapeDtypeStruct((seq_len + stack - 1, h, w),
+                                       obs_dtype)
+        obs_key = "seq_frames"
+    else:
+        obs_sds = jax.ShapeDtypeStruct((seq_len, *obs_shape), obs_dtype)
+        obs_key = "obs"
     return {
-        "obs": jax.ShapeDtypeStruct((seq_len, *obs_shape), obs_dtype),
+        obs_key: obs_sds,
         "actions": jax.ShapeDtypeStruct((seq_len,), np.int32),
         "rewards": jax.ShapeDtypeStruct((seq_len,), f32),
         "terminals": jax.ShapeDtypeStruct((seq_len,), f32),
@@ -46,12 +64,19 @@ class SequenceBuilder:
     """
 
     def __init__(self, seq_len: int = 80, overlap: int = 40,
-                 lstm_size: int = 512, priority_eta: float = 0.9):
+                 lstm_size: int = 512, priority_eta: float = 0.9,
+                 frame_mode: bool = False):
+        """frame_mode: emit single frames ("seq_frames") instead of
+        per-step stacks — valid for [H, W, stack] pixel obs whose
+        channels slide one frame per step (the Atari wrapper's
+        invariant; holds within an episode, and sequences never span
+        episodes)."""
         assert 0 <= overlap < seq_len
         self.seq_len = seq_len
         self.overlap = overlap
         self.lstm_size = lstm_size
         self.priority_eta = priority_eta
+        self.frame_mode = frame_mode
         self._steps: list[dict] = []  # each: obs/action/reward/terminal/pre_c/pre_h
         self._retained = 0  # leading steps already covered by a prior emit
 
@@ -111,14 +136,12 @@ class SequenceBuilder:
         assert n > 0
         length = self.seq_len
         first = steps[0]
-        obs = np.zeros((length, *first["obs"].shape), first["obs"].dtype)
         actions = np.zeros(length, np.int32)
         rewards = np.zeros(length, np.float32)
         terminals = np.zeros(length, np.float32)
         mask = np.zeros(length, np.float32)
         tds = np.zeros(n, np.float32)
         for i, s in enumerate(steps):
-            obs[i] = s["obs"]
             actions[i] = s["action"]
             rewards[i] = s["reward"]
             terminals[i] = float(s["terminal"])
@@ -126,12 +149,33 @@ class SequenceBuilder:
             tds[i] = s["td"]
         eta = self.priority_eta
         priority = eta * float(tds.max()) + (1 - eta) * float(tds.mean())
-        return {
-            "obs": obs, "actions": actions, "rewards": rewards,
+        item = {
+            "actions": actions, "rewards": rewards,
             "terminals": terminals, "mask": mask,
             "init_c": first["pre_c"], "init_h": first["pre_h"],
             "priority": priority,
         }
+        if self.frame_mode:
+            # single frames: [0:stack] = the first step's channels, then
+            # one new frame (newest channel) per step; obs stack at step
+            # i is frames[i:i+stack] by the sliding invariant. Pad the
+            # unmasked tail by repeating the last frame.
+            h, w, stack = first["obs"].shape
+            frames = np.zeros((length + stack - 1, h, w),
+                              first["obs"].dtype)
+            for c in range(stack):
+                frames[c] = first["obs"][..., c]
+            for i, s in enumerate(steps[1:], start=1):
+                frames[stack - 1 + i] = s["obs"][..., -1]
+            frames[stack - 1 + n:] = frames[stack - 2 + n]
+            item["seq_frames"] = frames
+        else:
+            obs = np.zeros((length, *first["obs"].shape),
+                           first["obs"].dtype)
+            for i, s in enumerate(steps):
+                obs[i] = s["obs"]
+            item["obs"] = obs
+        return item
 
 
 def split_priorities(items: list[dict]) -> tuple[list[dict], np.ndarray]:
@@ -152,10 +196,25 @@ def stack_items(items: list[dict]) -> dict:
 
 
 def batch_to_sequence_batch(items: Any):
-    """Device item batch (dict of [B, L, ...]) -> losses.SequenceBatch."""
+    """Device item batch (dict of [B, L, ...]) -> losses.SequenceBatch.
+
+    Frame-mode items carry "seq_frames" [B, L+stack-1, H, W]; the
+    per-step [B, L, H, W, stack] obs rebuild is `stack` slices stacked
+    on the channel axis — contiguous reads, no gather, fused into the
+    learner jit."""
+    import jax.numpy as jnp
+
     from ape_x_dqn_tpu.ops.losses import SequenceBatch
+    if "seq_frames" in items:
+        f = items["seq_frames"]
+        length = items["actions"].shape[-1]
+        stack = f.shape[1] - length + 1
+        obs = jnp.stack([f[:, c:c + length] for c in range(stack)],
+                        axis=-1)
+    else:
+        obs = items["obs"]
     return SequenceBatch(
-        obs=items["obs"], actions=items["actions"],
+        obs=obs, actions=items["actions"],
         rewards=items["rewards"], terminals=items["terminals"],
         mask=items["mask"],
         init_state=(items["init_c"], items["init_h"]))

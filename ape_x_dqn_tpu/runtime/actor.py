@@ -46,6 +46,8 @@ class Actor:
     the initial priority), `_action_array` (stacking dtype for shipment).
     """
 
+    _ships_frame_segments = True  # flat family only (see __init__)
+
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[np.ndarray], np.ndarray],
                  transport, seed: int | None = None,
@@ -68,9 +70,12 @@ class Actor:
         self._outbox: list[tuple[NStepTransition, float]] = []
         self._pending: list[NStepTransition] = []
         # frame-ring shipping (replay/frame_ring.py): transitions leave as
-        # fixed segments of single frames instead of stacked obs pairs
+        # fixed segments of single frames instead of stacked obs pairs.
+        # Only the flat family ships segments — RecurrentActor handles
+        # frame-mode inside its SequenceBuilder instead.
         self._seg: FrameSegmentBuilder | None = None
-        if getattr(cfg.replay, "storage", "flat") == "frame_ring":
+        if (self._ships_frame_segments
+                and getattr(cfg.replay, "storage", "flat") == "frame_ring"):
             spec = self.env.spec
             assert spec.discrete and len(spec.obs_shape) == 3, \
                 "frame_ring storage needs discrete [H, W, stack] pixel envs"
@@ -220,6 +225,8 @@ class ContinuousActor(Actor):
     actors do from max-Q (same one-step pending mechanism).
     """
 
+    _ships_frame_segments = False  # DPG obs are low-dimensional
+
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[np.ndarray], dict],
                  transport, seed: int | None = None,
@@ -269,7 +276,13 @@ class RecurrentActor(Actor):
     bookkeeping). A step's TD needs max_a Q(s_{t+1}), which arrives at
     the *next* server query — so each step parks for one iteration before
     entering the SequenceBuilder (mirroring Actor's pending list).
+
+    Frame-mode shipping (replay storage "frame_ring") happens inside the
+    SequenceBuilder (single frames per sequence), not via Actor's
+    flat-transition segment path.
     """
+
+    _ships_frame_segments = False
 
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[dict], dict],
@@ -279,9 +292,14 @@ class RecurrentActor(Actor):
                          episode_callback=episode_callback)
         self.gamma = cfg.learner.gamma
         self.lstm_size = cfg.network.lstm_size
+        frame_mode = cfg.replay.storage == "frame_ring"
+        if frame_mode:
+            assert len(self.env.spec.obs_shape) == 3, \
+                "frame_ring sequence storage needs [H, W, stack] pixel obs"
         self.builder = SequenceBuilder(
             seq_len=cfg.replay.seq_length, overlap=cfg.replay.seq_overlap,
-            lstm_size=self.lstm_size, priority_eta=cfg.replay.priority_eta)
+            lstm_size=self.lstm_size, priority_eta=cfg.replay.priority_eta,
+            frame_mode=frame_mode)
         # ingest_batch counts transitions; sequences ship in proportionally
         # smaller groups so ingest latency stays comparable
         self.ship_after = max(1, cfg.actors.ingest_batch

@@ -71,9 +71,16 @@ class ApexDriver:
             z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
             params = self.net.init(component_key(cfg.seed, "net_init"),
                                    obs0[None, None], (z, z))
+            seq_frame_mode = cfg.replay.storage == "frame_ring"
+            if seq_frame_mode and len(self.spec.obs_shape) != 3:
+                raise ValueError(
+                    f"frame_ring sequence storage needs [H, W, stack] "
+                    f"pixel obs, got {self.spec.obs_shape}; set "
+                    f"replay.storage='flat' for vector observations")
             item_spec = sequence_item_spec(
                 self.spec.obs_shape, self.spec.obs_dtype,
-                cfg.replay.seq_length, cfg.network.lstm_size)
+                cfg.replay.seq_length, cfg.network.lstm_size,
+                frame_mode=seq_frame_mode)
         elif self.family == "dpg":
             actor_net, critic_net = self.net
             a0 = jnp.zeros((1, self.spec.action_dim), jnp.float32)
@@ -90,12 +97,22 @@ class ApexDriver:
                                    obs0[None])
             item_spec = transition_item_spec(self.spec.obs_shape,
                                              self.spec.obs_dtype)
-        self._frame_mode = cfg.replay.storage == "frame_ring"
+        # frame_ring storage: single-frame pixel layouts. For the flat
+        # family it switches the replay class + segment staging
+        # (_frame_mode below); for r2d2 it only changes the sequence item
+        # content (single frames, rebuilt by batch_to_sequence_batch) —
+        # same replay, same staging. DPG obs are low-dimensional.
+        if cfg.replay.storage == "frame_ring" and self.family == "dpg":
+            raise NotImplementedError(
+                "frame_ring storage is for pixel families (dqn/r2d2); "
+                "use storage='flat' for dpg")
+        self._frame_mode = (cfg.replay.storage == "frame_ring"
+                            and self.family == "dqn")
         if self._frame_mode:
-            if self.family != "dqn" or cfg.replay.kind != "prioritized":
+            if cfg.replay.kind != "prioritized":
                 raise NotImplementedError(
-                    "frame_ring storage covers the prioritized flat-DQN "
-                    "family (pixel envs); use storage='flat' otherwise")
+                    "flat-family frame_ring storage requires "
+                    "prioritized replay")
             item_spec = frame_segment_spec(
                 cfg.replay.seg_transitions, cfg.learner.n_step,
                 self.spec.obs_shape, self.spec.obs_dtype)

@@ -174,6 +174,7 @@ def test_two_process_training_over_tcp():
 
 _R2D2_SETS = [
     "env.kind=cartpole_po", "env.id=CartPolePO",
+    "replay.storage=flat",  # preset is frame_ring, needs pixel obs
     "network.lstm_size=32", "network.torso_dense=64",
     "network.compute_dtype=float32",
     "replay.capacity=512", "replay.seq_length=16", "replay.seq_overlap=8",
