@@ -1,0 +1,73 @@
+"""Pallas TPU kernel: batched frame-row gather for the replay sample path.
+
+The frame-ring sample (replay/frame_ring.py) reconstructs observation
+stacks by gathering ~B*stack*2 single frames (512-sample batch -> 4096
+rows of ~7KB = ~28MB) from the HBM frames ring. XLA lowers this to a
+generic gather; this kernel expresses it as the canonical Pallas
+embedding-lookup instead: the row indices are SCALAR-PREFETCHED
+(pltpu.PrefetchScalarGridSpec), so the pipeline knows each grid step's
+source block before it runs and streams row DMAs HBM->VMEM
+double-buffered, one output row per grid step.
+
+The kernel body is a pure copy — all the work is in the index map — so
+correctness is trivially checkable against the jnp fallback
+(`gather_rows_reference`).
+
+MEASURED RESULT (one v5e chip, 4096 rows of 7KB from a 2.5GB ring; see
+PERF.md): XLA's native gather wins by ~13x (0.023ms vs 0.31ms) — its
+bulk gather is already DMA-optimal at these row sizes, while the
+one-row-per-grid-step pipeline pays per-step overhead 4096 times. The
+replay therefore keeps the plain jnp gather; this module stays as the
+measured reference for the scalar-prefetch gather pattern (and the
+integration point if a future op — e.g. a fused descent+gather — needs
+custom DMA scheduling).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def gather_rows_reference(src: jax.Array, idx: jax.Array) -> jax.Array:
+    """jnp fallback: src [N, H, W], idx [M] int32 -> [M, H, W]."""
+    return src[idx]
+
+
+def _copy_row_kernel(idx_ref, src_row, out_row):  # noqa: ARG001
+    # idx_ref is consumed by the BlockSpec index maps; the body only
+    # lands the selected row
+    out_row[:] = src_row[:]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def gather_rows_pallas(src: jax.Array, idx: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """Pallas row gather: src [N, H, W], idx [M] int32 -> [M, H, W].
+
+    One grid step per output row; the source BlockSpec's index map reads
+    the prefetched idx array, so Pallas's automatic pipelining overlaps
+    the next row's DMA with the current copy (double buffering).
+    interpret=True runs the kernel on CPU for tests.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = idx.shape[0]
+    n, h, w = src.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[
+            pl.BlockSpec((1, h, w), lambda i, idx_ref: (idx_ref[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w), lambda i, idx_ref: (i, 0, 0)),
+    )
+    return pl.pallas_call(
+        _copy_row_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, h, w), src.dtype),
+        interpret=interpret,
+    )(idx.astype(jnp.int32), src)

@@ -1,0 +1,27 @@
+"""Pallas frame-row gather (ops/frame_gather.py): interpret-mode
+correctness against the jnp reference. The TPU performance comparison
+that decided AGAINST adopting it lives in PERF.md."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.ops.frame_gather import (
+    gather_rows_pallas, gather_rows_reference)
+
+
+def test_pallas_gather_matches_reference():
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(0, 255, (500, 84, 84)), jnp.uint8)
+    idx = jnp.asarray(rng.integers(0, 500, 128), jnp.int32)
+    out = gather_rows_pallas(src, idx, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(gather_rows_reference(src, idx)))
+
+
+def test_pallas_gather_duplicate_and_boundary_indices():
+    rng = np.random.default_rng(1)
+    src = jnp.asarray(rng.integers(0, 255, (8, 6, 6)), jnp.uint8)
+    idx = jnp.asarray([0, 7, 7, 3, 0, 7], jnp.int32)
+    out = gather_rows_pallas(src, idx, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(src)[
+        np.asarray(idx)])
