@@ -39,6 +39,7 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -77,6 +78,7 @@ class _DistLearnerBase:
         self.optimizer = optimizer or make_optimizer(lcfg)
         self._dp_sharding = NamedSharding(mesh, P("dp"))
         self._repl_sharding = NamedSharding(mesh, P())
+        self._reshard = None  # publish_params' cached jit (built once)
 
     def _make_batch(self, items: Any) -> Any:
         raise NotImplementedError
@@ -86,10 +88,19 @@ class _DistLearnerBase:
     def init(self, params: Any, item_spec: Any,
              rng: jax.Array) -> DistTrainState:
         param_shardings = make_param_shardings(params, self.mesh)
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s),
-            params, param_shardings)
-        target = jax.tree.map(jnp.copy, params)
+
+        # make_array_from_callback instead of device_put: the mesh may
+        # span processes (multihost), where device_put to a non-
+        # addressable sharding is an error; the callback hands each
+        # process the slices it owns from its (identical, same-seed)
+        # host copy
+        def put(x, sharding):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, sharding, lambda idx: x[idx])
+
+        params = jax.tree.map(put, params, param_shardings)
+        target = jax.jit(partial(jax.tree.map, jnp.copy))(params)
         opt_state = jax.jit(self.optimizer.init)(params)
 
         def one_shard_replay(_):
@@ -104,8 +115,8 @@ class _DistLearnerBase:
                                            jax.vmap(one_shard_replay),
                                            jnp.arange(self.dp))),
         )(jnp.arange(self.dp))
-        rngs = jax.device_put(jax.random.split(rng, self.dp),
-                              self._dp_sharding)
+        rngs = jax.jit(lambda k: jax.random.split(k, self.dp),
+                       out_shardings=self._dp_sharding)(rng)
         return DistTrainState(params, target, opt_state, replay0, rngs,
                               jnp.int32(0))
 
@@ -203,14 +214,21 @@ class _DistLearnerBase:
         the reference's learner->actor weight broadcast (SURVEY.md §2.3
         item 3), without interrupting train_many dispatches.
 
-        The jnp.copy is load-bearing: device_put ALIASES leaves whose
-        sharding is already replicated (small biases), and the learner
-        jits donate the TrainState — an aliased publication would hand
-        the inference server buffers that the next add/train_step
-        deletes.
+        The resharding runs under jit with replicated out_shardings —
+        the multihost-safe form (device_put cannot target non-
+        addressable shardings), and the jit's fresh output buffers also
+        make the copy donation-safe: the learner jits donate the
+        TrainState, so an aliased publication would hand the inference
+        server buffers that the next add/train_step deletes.
         """
-        repl = jax.device_put(state.params, self._repl_sharding)
-        return jax.tree.map(jnp.copy, repl)
+        if self._reshard is None:
+            # built once: a fresh jax.jit wrapper per publish would
+            # retrace/recompile on the hot weight-broadcast path
+            self._reshard = jax.jit(
+                partial(jax.tree.map, jnp.copy),
+                out_shardings=jax.tree.map(
+                    lambda _: self._repl_sharding, state.params))
+        return self._reshard(state.params)
 
 
 class DistDQNLearner(_DistLearnerBase):

@@ -1,0 +1,134 @@
+"""Multi-host (multi-process) learner support over JAX's distributed
+runtime.
+
+The reference scales its learner across hosts with NCCL/MPI process
+groups (SURVEY.md §2.2 "Comm: NCCL", §5 "distributed communication
+backend"); the TPU-native equivalent is `jax.distributed` + GSPMD: every
+learner process calls `init_multihost` (which wires the coordination
+service), builds ONE global `(dp, tp)` mesh over all processes' devices,
+and then executes the SAME jitted programs on globally-sharded arrays —
+XLA inserts the cross-host collectives (grad psum, publication
+all-gather) over ICI within a host and DCN between hosts (Gloo on CPU
+test rigs).
+
+The host-side contract this module provides to the multihost driver
+(runtime/multihost_driver.py):
+
+- `process_rows(mesh)`: which contiguous dp rows this process owns —
+  ingest routes each host's actor experience into its own replay shards
+  (no cross-host experience traffic, mirroring the reference's
+  per-learner replay locality).
+- `make_global(mesh, local)`: wrap this process's [dp_local, ...] block
+  into the global [dp, ...] array GSPMD programs consume.
+- `global_sum` / `global_min`: tiny collective reductions of host-local
+  scalars (frame counts, stage depths). Every control-flow decision in
+  the multihost driver derives from these or from global jit outputs,
+  which is what keeps all processes' call sequences in lockstep — a
+  process branching on a host-local value would deadlock the others
+  inside a collective.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_multihost(coordinator: str, num_processes: int,
+                   process_id: int) -> None:
+    """Join the JAX distributed coordination service. Must run before
+    any backend use (the CLI calls it first thing).
+
+    Honors a JAX_PLATFORMS env override through jax.config: interpreter
+    startup hooks (e.g. a sitecustomize registering an experimental TPU
+    plugin) can import jax before this runs, and the env var alone is
+    then too late — the config update still wins as long as no backend
+    has been initialized."""
+    import os
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def process_rows(mesh: Mesh) -> tuple[int, int]:
+    """[start, stop) dp rows owned by this process.
+
+    Mesh rows are process-contiguous because make_mesh reshapes
+    jax.devices() (globally ordered by process) into (dp, tp); asserts
+    that a row never straddles processes (tp must divide the local
+    device count)."""
+    dp = mesh.shape["dp"]
+    tp = mesh.shape.get("tp", 1)
+    local = jax.local_device_count()
+    nproc = jax.process_count()
+    assert local % tp == 0, \
+        f"tp={tp} must divide local device count {local} (a tensor-" \
+        f"parallel row cannot straddle hosts: tp collectives ride ICI)"
+    rows_per_proc = dp // nproc
+    assert rows_per_proc * nproc == dp, \
+        f"dp={dp} must divide by process count {nproc}"
+    start = jax.process_index() * rows_per_proc
+    return start, start + rows_per_proc
+
+
+def make_global(mesh: Mesh, local: Any) -> Any:
+    """Per-process [dp_local, ...] pytree -> global [dp, ...] arrays
+    sharded P('dp') (each process contributes its own rows)."""
+    dp = mesh.shape["dp"]
+    sharding = NamedSharding(mesh, P("dp"))
+
+    def one(x):
+        x = np.asarray(x)
+        global_shape = (dp,) + x.shape[1:]
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape)
+
+    return jax.tree.map(one, local)
+
+
+_LIMB = 1 << 20  # see global_sum
+
+
+def _rows(mesh: Mesh, row_value: np.ndarray) -> Any:
+    """Each process fills its dp rows with row_value -> global [dp, ...]
+    array for a replicated-out reduction. Deterministic and identical
+    on every process."""
+    start, stop = process_rows(mesh)
+    return make_global(
+        mesh, np.tile(row_value[None], (stop - start,) + (1,) *
+                      row_value.ndim))
+
+
+def global_sum(mesh: Mesh, value: float) -> float:
+    """Exact sum of each PROCESS's non-negative integer-valued scalar.
+
+    f32 device arrays round integers above 2^24 (frame counts reach
+    billions at atari57 scale, and a rounded-down global count would
+    stall the frame-budget termination forever), so the value rides as
+    two base-2^20 limbs — each limb and each limb-sum stays well inside
+    f32's exact-integer range for any sane process count — and the
+    limbs recombine exactly in Python ints."""
+    v = int(value)
+    limbs = np.asarray([v // _LIMB, v % _LIMB], np.float32)
+    arr = _rows(mesh, limbs)  # [dp, 2]
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(partial(jnp.sum, axis=0), out_shardings=repl)
+    start, stop = process_rows(mesh)
+    hi, lo = (np.asarray(fn(arr)) / (stop - start)).tolist()
+    return float(int(round(hi)) * _LIMB + int(round(lo)))
+
+
+def global_min(mesh: Mesh, value: float) -> float:
+    """Min of each process's scalar (used for 0/1 readiness flags)."""
+    arr = _rows(mesh, np.asarray([np.float32(value)]))
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(jnp.min, out_shardings=repl)
+    return float(fn(arr))

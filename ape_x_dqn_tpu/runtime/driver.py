@@ -51,6 +51,22 @@ from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
 
 
+def build_prioritized_replay(cfg: RunConfig, spec, capacity: int,
+                             frame_mode: bool):
+    """Prioritized replay at `capacity` (single-chip total or per-dp
+    shard) in the configured storage layout. Shared by ApexDriver and
+    the multihost driver."""
+    r = cfg.replay
+    if frame_mode:
+        return FrameRingReplay(
+            capacity=capacity, seg_transitions=r.seg_transitions,
+            n_step=cfg.learner.n_step,
+            obs_shape=spec.obs_shape, obs_dtype=spec.obs_dtype,
+            alpha=r.alpha, beta=r.beta, eps=r.eps)
+    return PrioritizedReplay(capacity=capacity, alpha=r.alpha,
+                             beta=r.beta, eps=r.eps)
+
+
 class ApexDriver:
     def __init__(self, cfg: RunConfig, metrics: Metrics | None = None,
                  transport=None):
@@ -248,17 +264,8 @@ class ApexDriver:
             self._maybe_restore()
 
     def _build_prioritized(self, capacity: int):
-        """Prioritized replay at `capacity` (single-chip total or per-dp
-        shard) in the configured storage layout."""
-        r = self.cfg.replay
-        if self._frame_mode:
-            return FrameRingReplay(
-                capacity=capacity, seg_transitions=r.seg_transitions,
-                n_step=self.cfg.learner.n_step,
-                obs_shape=self.spec.obs_shape, obs_dtype=self.spec.obs_dtype,
-                alpha=r.alpha, beta=r.beta, eps=r.eps)
-        return PrioritizedReplay(capacity=capacity, alpha=r.alpha,
-                                 beta=r.beta, eps=r.eps)
+        return build_prioritized_replay(self.cfg, self.spec, capacity,
+                                        self._frame_mode)
 
     # -- checkpoint / resume ----------------------------------------------
 

@@ -1,0 +1,310 @@
+"""Multi-host Ape-X: one learner process per host, SPMD lockstep.
+
+The reference's multi-host learner is NCCL/MPI process groups running
+synchronized training steps while each host ingests its own actors'
+experience (SURVEY.md §5 "distributed communication backend"). The
+TPU-native shape of that design:
+
+- Every process builds the SAME global (dp, tp) mesh (parallel/mesh.py
+  over jax.devices(), which spans hosts under jax.distributed) and the
+  same DistDQNLearner; GSPMD inserts the cross-host collectives.
+- Each host runs its OWN actors + batched inference server + transport;
+  experience lands only in the dp replay rows that host owns
+  (parallel/multihost.process_rows) — experience never crosses hosts,
+  exactly like the reference's per-learner replay locality.
+- The learner loop is a synchronous ROUND protocol instead of the
+  single-host driver's free-running threads: jitted programs on global
+  arrays are collectives, so every process must issue the identical
+  call sequence. Each round:
+
+      1. all processes agree (global_min) whether every host has a
+         full ingest block staged; if so, all call `add` together —
+         gating beats padding, because dead filler items would cycle
+         the replay ring and evict real experience on idle hosts;
+      2. the replay fill check, train_many dispatch, publication
+         boundary, and termination all branch on GLOBAL values (jit
+         outputs or global_sum/min reductions), never on host-local
+         state.
+
+  A host whose actors all die stalls global ingest (training continues
+  on existing data); a host whose PROCESS dies hangs the collectives —
+  the same failure domain as the reference's NCCL group, recovered by
+  restarting the job from a checkpoint.
+
+Run via the CLI:
+    python -m ape_x_dqn_tpu.runtime.train --config pong \
+        --coordinator HOST:PORT --num-processes 2 --process-id 0 ...
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import jax
+import numpy as np
+
+from ape_x_dqn_tpu.comm.transport import LoopbackTransport
+from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
+from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
+from ape_x_dqn_tpu.parallel.mesh import make_mesh
+from ape_x_dqn_tpu.parallel import multihost
+from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
+from ape_x_dqn_tpu.runtime.driver import build_prioritized_replay
+from ape_x_dqn_tpu.runtime.family import (
+    actor_class, family_of, server_apply_fn, warmup_example)
+from ape_x_dqn_tpu.runtime.learner import transition_item_spec
+from ape_x_dqn_tpu.utils.metrics import Metrics
+from ape_x_dqn_tpu.utils.misc import next_pow2
+from ape_x_dqn_tpu.utils.rng import component_key
+
+
+class MultihostApexDriver:
+    """Synchronous-round Ape-X driver; one instance per learner process.
+
+    Supports the flat-DQN family (both storage layouts). The recurrent
+    and continuous families run multi-host today by putting their
+    ACTORS on remote hosts (runtime/actor_host.py) against a
+    single-process learner; extending this lockstep loop to them is
+    mechanical (same learners, same round protocol) once a workload
+    needs it.
+    """
+
+    def __init__(self, cfg: RunConfig, metrics: Metrics | None = None,
+                 transport=None):
+        assert jax.process_count() > 1, \
+            "MultihostApexDriver requires jax.distributed (use ApexDriver " \
+            "for single-process runs)"
+        self.cfg = cfg
+        self.family = family_of(cfg)
+        if self.family != "dqn":
+            raise NotImplementedError(
+                "multihost lockstep loop covers the flat-DQN family; "
+                "run r2d2/dpg learners single-process with remote actor "
+                "hosts (runtime/actor_host.py)")
+        self.metrics = metrics or Metrics()
+        probe_env = make_env(cfg.env, seed=cfg.seed)
+        self.spec = probe_env.spec
+        self.net = build_network(cfg.network, self.spec)
+        obs0 = probe_env.reset()
+        params = self.net.init(component_key(cfg.seed, "net_init"),
+                               obs0[None])
+
+        self.mesh = make_mesh(dp=cfg.parallel.dp, tp=cfg.parallel.tp)
+        self.row_start, self.row_stop = multihost.process_rows(self.mesh)
+        self.dp = cfg.parallel.dp
+        self.dp_local = self.row_stop - self.row_start
+
+        self._frame_mode = cfg.replay.storage == "frame_ring"
+        if self._frame_mode:
+            item_spec = frame_segment_spec(
+                cfg.replay.seg_transitions, cfg.learner.n_step,
+                self.spec.obs_shape, self.spec.obs_dtype)
+            self._unit_items = cfg.replay.seg_transitions
+            self._chunk = max(cfg.replay.segs_per_add, 1)
+        else:
+            item_spec = transition_item_spec(self.spec.obs_shape,
+                                             self.spec.obs_dtype)
+            self._unit_items = 1
+            self._chunk = max(cfg.actors.ingest_batch, 1)
+        self._item_keys = tuple(item_spec.keys())
+
+        # identical construction on every process (same cfg.seed) ->
+        # identical initial params; learner.init then shards them over
+        # the global mesh (a collective: all processes reach this line)
+        shard_cap = next_pow2(max(cfg.replay.capacity // self.dp, 2))
+        self.replay = build_prioritized_replay(cfg, self.spec, shard_cap,
+                                               self._frame_mode)
+        self.capacity = shard_cap * self.dp
+        self.learner = DistDQNLearner(self.net.apply, self.replay,
+                                      cfg.learner, self.mesh)
+        self.state = self.learner.init(
+            params, item_spec, component_key(cfg.seed, "learner"))
+
+        # publication is a global collective (tp all-gather + cross-host
+        # replication); the inference server's jit runs process-LOCALLY,
+        # so it gets a host copy — a global array would not mix with the
+        # server's local inputs
+        server_params = self._host_params()
+        self.server = BatchedInferenceServer(
+            server_apply_fn(self.family, self.net), server_params,
+            max_batch=cfg.inference.max_batch,
+            deadline_ms=cfg.inference.deadline_ms)
+        self.transport = transport if transport is not None \
+            else LoopbackTransport()
+        self.transport.publish_params(server_params, 0)
+
+        self.stop_event = threading.Event()
+        self.episode_returns: deque[float] = deque(maxlen=200)
+        self._frames_local = 0
+        self._grad_steps = 0
+        self._stage: list[dict] = []
+        self._stage_n = 0
+        self._lock = threading.Lock()
+        self.actor_errors: list[tuple[int, Exception]] = []
+
+    def _host_params(self):
+        """publish_params (collective, all processes call) -> host numpy
+        (valid per-process because the result is fully replicated)."""
+        pub = self.learner.publish_params(self.state)
+        return jax.tree.map(np.asarray, pub)
+
+    # -- local actor plumbing (per host) ----------------------------------
+
+    def _on_episode(self, actor_index: int, info: dict) -> None:
+        with self._lock:
+            self.episode_returns.append(float(info["episode_return"]))
+
+    def _actor_thread(self, i: int, max_frames: int) -> None:
+        try:
+            actor = actor_class(self.family)(
+                self.cfg, i, self.server.query, self.transport,
+                episode_callback=self._on_episode)
+            actor.run(max_frames, self.stop_event)
+        except Exception as e:  # noqa: BLE001 - reported in run() output
+            with self._lock:
+                self.actor_errors.append((i, e))
+
+    def _pump_ingest(self) -> None:
+        """Drain the transport into the local stage (runs each round —
+        no separate ingest thread: the round loop owns the state)."""
+        while True:
+            batch = self.transport.recv_experience(timeout=0.0)
+            if batch is None:
+                return
+            n = int(batch["priorities"].shape[0])
+            with self._lock:
+                self._frames_local += int(batch.get("frames", n))
+            self._stage.append(batch)
+            self._stage_n += n
+
+    def _pop_block(self) -> dict | None:
+        """Take one [dp_local, chunk, ...] block off the stage."""
+        need = self.dp_local * self._chunk
+        if self._stage_n < need:
+            return None
+        fields = {
+            k: np.concatenate([np.asarray(b[k]) for b in self._stage])
+            for k in self._item_keys + ("priorities",)}
+        take = {k: v[:need].reshape(self.dp_local, self._chunk,
+                                    *v.shape[1:])
+                for k, v in fields.items()}
+        rest = {k: v[need:] for k, v in fields.items()}
+        self._stage = [rest] if rest["priorities"].shape[0] else []
+        self._stage_n -= need
+        return take
+
+    def _min_fill(self) -> int:
+        return min(self.cfg.replay.min_fill, self.capacity // 2)
+
+    # -- the lockstep round loop ------------------------------------------
+
+    def run(self, total_env_frames: int | None = None,
+            max_grad_steps: int = 10**9) -> dict:
+        """Round loop. Termination derives from global frame/step counts
+        only (wall clocks differ across hosts and would diverge the
+        call sequences)."""
+        cfg = self.cfg
+        total = total_env_frames or cfg.total_env_frames
+        per_actor = (total // max(jax.process_count(), 1)
+                     // max(cfg.actors.num_actors, 1))
+        publish_every = cfg.learner.publish_every
+        chunk_steps = max(min(cfg.learner.train_chunk, publish_every), 1)
+
+        threads = [threading.Thread(target=self._actor_thread,
+                                    args=(i, per_actor),
+                                    name=f"actor-{i}", daemon=True)
+                   for i in range(cfg.actors.num_actors)]
+        self.server.warmup(warmup_example(self.family, cfg, self.spec))
+        for t in threads:
+            t.start()
+
+        t0 = time.monotonic()
+        filled = 0
+        frames_global = 0.0
+        loss = float("nan")
+        global_size = jax.jit(
+            lambda s: s.replay.size.sum(),
+            out_shardings=jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec()))
+        while True:
+            self._pump_ingest()
+            progressed = False
+            # 1. collective ingest, gated on EVERY host having a block
+            blocks_ready = 1.0 if self._stage_n >= \
+                self.dp_local * self._chunk else 0.0
+            if multihost.global_min(self.mesh, blocks_ready) >= 1.0:
+                block = self._pop_block()
+                items = multihost.make_global(
+                    self.mesh,
+                    {k: v for k, v in block.items() if k != "priorities"})
+                pris = multihost.make_global(self.mesh,
+                                             block["priorities"])
+                self.state = self.learner.add(self.state, items, pris)
+                filled = int(global_size(self.state))
+                progressed = True
+            # 2. lockstep training, branch on global values only
+            if filled >= self._min_fill() \
+                    and self._grad_steps < max_grad_steps:
+                to_publish = publish_every - (self._grad_steps
+                                              % publish_every)
+                k = chunk_steps if chunk_steps <= min(
+                    max_grad_steps - self._grad_steps, to_publish) else 1
+                self.state, m = self.learner.train_many(self.state, k)
+                self._grad_steps += k
+                loss = float(m["loss"])
+                progressed = True
+                if self._grad_steps % publish_every == 0:
+                    pub = self._host_params()
+                    self.server.update_params(pub, self._grad_steps)
+                    self.transport.publish_params(pub, self._grad_steps)
+            # 3. global termination — all conditions from global values.
+            # `local_idle`: this host can never ingest again (actors
+            # finished/dead, transport drained, stage short of a block) —
+            # guards against frame counts that never reach `total`
+            # (lossy-transport drops, per-actor truncation of the budget)
+            with self._lock:
+                frames_local = self._frames_local
+            frames_global = multihost.global_sum(self.mesh,
+                                                 float(frames_local))
+            local_idle = 1.0 if (not any(t.is_alive() for t in threads)
+                                 and self.transport.pending == 0
+                                 and blocks_ready < 1.0) else 0.0
+            all_idle = multihost.global_min(self.mesh, local_idle) >= 1.0
+            if self._grad_steps >= max_grad_steps:
+                break
+            if frames_global >= total and max_grad_steps >= 10**9:
+                break  # frame-budget run: actors are done
+            if all_idle and (max_grad_steps >= 10**9
+                             or filled < self._min_fill()):
+                # ingest can never resume anywhere; either there is no
+                # finite step target to chase, or training can never
+                # start — spinning helps nobody
+                break
+            if not progressed:
+                # idle round: don't hammer the coordination service
+                # (sleep is host-local pacing, no collective is skipped)
+                time.sleep(0.05)
+
+        self.stop_event.set()
+        for t in threads:
+            t.join(timeout=5)
+        self.server.stop()
+        with self._lock:
+            avg_ret = (float(np.mean(self.episode_returns))
+                       if self.episode_returns else 0.0)
+        return {
+            "process": jax.process_index(),
+            "frames": int(frames_global),
+            "frames_local": self._frames_local,
+            "grad_steps": self._grad_steps,
+            "loss": loss,
+            "replay_filled": filled,
+            "avg_return": avg_ret,
+            "wall_s": time.monotonic() - t0,
+            "actor_errors": [f"{i}: {e!r}" for i, e in self.actor_errors],
+        }

@@ -105,6 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="config-1 style in-process loop (no threads)")
     ap.add_argument("--listen", default=None, metavar="HOST:PORT",
                     help="also accept remote actor hosts over TCP")
+    # multi-host learner (one process per host, SPMD lockstep over a
+    # global mesh — runtime/multihost_driver.py); all three must be set
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="jax.distributed coordinator address")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument("--set", action="append", default=[],
                     metavar="dotted.key=value",
                     help="override any config field, e.g. "
@@ -113,7 +119,25 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.coordinator is not None:
+        if args.num_processes is None or args.process_id is None:
+            parser.error("--coordinator requires --num-processes and "
+                         "--process-id")
+        if args.checkpoint_dir or args.wall_clock_limit is not None:
+            # wall clocks differ across hosts (they would diverge the
+            # lockstep call sequences) and checkpointing is not wired
+            # into the multihost round loop yet — reject rather than
+            # silently ignore
+            parser.error("--checkpoint-dir / --wall-clock-limit are not "
+                         "supported in multihost mode yet")
+        if args.single_process:
+            parser.error("--single-process and --coordinator conflict")
+        # must happen before any JAX backend use
+        from ape_x_dqn_tpu.parallel.multihost import init_multihost
+        init_multihost(args.coordinator, args.num_processes,
+                       args.process_id)
     cfg = get_config(args.config)
     if args.seed is not None:
         cfg = cfg.replace(seed=args.seed)
@@ -129,7 +153,24 @@ def main(argv: list[str] | None = None) -> int:
     cfg = apply_overrides(cfg, args.set)
 
     metrics = Metrics(log_path=args.metrics_file)
-    if args.single_process:
+    if args.coordinator is not None:
+        from ape_x_dqn_tpu.runtime.multihost_driver import (
+            MultihostApexDriver)
+        transport = server = None
+        if args.listen:
+            from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
+            host, port = args.listen.rsplit(":", 1)
+            server = transport = SocketIngestServer(host, int(port))
+            print(f"ingest listening on {host}:{server.port}",
+                  file=sys.stderr, flush=True)
+        driver = MultihostApexDriver(cfg, metrics=metrics,
+                                     transport=transport)
+        try:
+            out = driver.run(max_grad_steps=args.max_grad_steps)
+        finally:
+            if server is not None:
+                server.stop()
+    elif args.single_process:
         from ape_x_dqn_tpu.runtime.single_process import train_single_process
         out = train_single_process(cfg, metrics=metrics)
     else:
