@@ -188,6 +188,13 @@ class SocketIngestServer:
     def pending(self) -> int:
         return self._q.qsize()
 
+    @property
+    def active_connections(self) -> int:
+        """Live remote actor-host connections (readers deregister on
+        disconnect). Drivers use this for idle/termination checks — a
+        drained queue does not mean producers are done."""
+        return len(self._conns)
+
     def stop(self) -> None:
         self._stop.set()
         self._accept_thread.join(timeout=2)

@@ -119,6 +119,10 @@ class ActorConfig:
 class InferenceConfig:
     max_batch: int = 64
     deadline_ms: float = 2.0  # dynamic batching deadline
+    # shard query batches over the learner's (dp, tp) mesh (replicated
+    # params, leading axis split) when running distributed; forwards/s
+    # then scales with chip count
+    shard_over_mesh: bool = True
 
 
 @dataclass(frozen=True)

@@ -14,6 +14,14 @@ power of two so XLA compiles a handful of bucket shapes once.
 Generic over the request pytree: a request is (inputs_pytree,) and the
 reply is outputs_pytree — plain Q-nets send obs and get Q-values;
 recurrent nets send (obs, (c, h)) and get (q, (c', h')).
+
+Mesh-sharded mode: pass `mesh` to shard each batch's leading axis across
+every device of a `jax.sharding.Mesh` with the params replicated, so
+forwards/s scales with chip count (SURVEY.md §5 "weight broadcast →
+all-gather over ICI to inference-server shards"). Buckets round up to a
+multiple of the mesh size so every shard gets identical work; the dist
+learner's `publish_params` already hands over mesh-replicated buffers,
+so a publication is exactly the ICI all-gather the survey names.
 """
 
 from __future__ import annotations
@@ -25,6 +33,7 @@ from typing import Any, Callable
 
 import jax
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ape_x_dqn_tpu.utils.misc import next_pow2
 
@@ -40,9 +49,33 @@ class _Request:
 
 class BatchedInferenceServer:
     def __init__(self, apply_fn: Callable, params: Any,
-                 max_batch: int = 64, deadline_ms: float = 2.0):
-        """apply_fn(params, batched_inputs_pytree) -> batched outputs."""
-        self._apply = jax.jit(apply_fn)
+                 max_batch: int = 64, deadline_ms: float = 2.0,
+                 mesh: Mesh | None = None):
+        """apply_fn(params, batched_inputs_pytree) -> batched outputs.
+
+        mesh: optional — shard every batch's leading axis over all mesh
+        devices (params replicated); see module docstring.
+        """
+        if mesh is not None:
+            # One sharding as a pytree prefix: dim 0 of every input and
+            # output leaf is split over the flattened (dp, tp) device
+            # grid; params replicate. Numpy inputs commit to these
+            # shardings at dispatch, replies gather back host-side.
+            batched = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+            self._apply = jax.jit(
+                apply_fn,
+                in_shardings=(NamedSharding(mesh, P()), batched),
+                out_shardings=batched)
+            # explicit placement before dispatch: under a multi-process
+            # runtime, jit rejects numpy args with non-trivial shardings
+            # (it cannot tell process-local from global data); device_put
+            # onto the (all-addressable) local mesh is unambiguous
+            self._batched_sharding = batched
+            self._min_bucket = int(mesh.size)
+        else:
+            self._apply = jax.jit(apply_fn)
+            self._batched_sharding = None
+            self._min_bucket = 1
         self._params = params
         self._params_version = 0
         self._max_batch = max_batch
@@ -81,10 +114,12 @@ class BatchedInferenceServer:
         irrelevant; only shapes/dtypes feed the compile cache)."""
         with self._lock:
             params = self._params
-        for b in sorted({1, next_pow2(self._max_batch)}):
+        for b in sorted({self._bucket(1), self._bucket(self._max_batch)}):
             stacked = jax.tree.map(
                 lambda x: np.zeros((b, *np.asarray(x).shape),
                                    np.asarray(x).dtype), example_input)
+            if self._batched_sharding is not None:
+                stacked = jax.device_put(stacked, self._batched_sharding)
             self._apply.lower(params, stacked).compile()
 
     # -- learner side ------------------------------------------------------
@@ -141,11 +176,21 @@ class BatchedInferenceServer:
                     r.result = e
                     r.event.set()
 
+    def _bucket(self, n: int) -> int:
+        """Padded batch size: next pow2, rounded up to a multiple of the
+        mesh size in sharded mode so every shard gets identical work."""
+        b = next_pow2(max(n, 1))
+        if b % self._min_bucket:
+            b = -(-b // self._min_bucket) * self._min_bucket
+        return b
+
     def _serve_batch(self, reqs: list[_Request]) -> None:
         n = len(reqs)
-        padded = next_pow2(max(n, 1))
+        padded = self._bucket(n)
         stacked = jax.tree.map(
             lambda *xs: _pad_stack(xs, padded), *[r.inputs for r in reqs])
+        if self._batched_sharding is not None:
+            stacked = jax.device_put(stacked, self._batched_sharding)
         with self._lock:
             params = self._params
         out = self._apply(params, stacked)

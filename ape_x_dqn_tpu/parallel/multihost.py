@@ -20,17 +20,16 @@ The host-side contract this module provides to the multihost driver
   per-learner replay locality).
 - `make_global(mesh, local)`: wrap this process's [dp_local, ...] block
   into the global [dp, ...] array GSPMD programs consume.
-- `global_sum` / `global_min`: tiny collective reductions of host-local
-  scalars (frame counts, stage depths). Every control-flow decision in
-  the multihost driver derives from these or from global jit outputs,
-  which is what keeps all processes' call sequences in lockstep — a
-  process branching on a host-local value would deadlock the others
-  inside a collective.
+- `global_stats`: ONE packed collective reduction per round of the
+  host-local control scalars (ingest readiness, idleness, frame
+  counts). Every control-flow decision in the multihost driver derives
+  from it or from global jit outputs, which is what keeps all
+  processes' call sequences in lockstep — a process branching on a
+  host-local value would deadlock the others inside a collective.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
@@ -69,6 +68,11 @@ def process_rows(mesh: Mesh) -> tuple[int, int]:
     tp = mesh.shape.get("tp", 1)
     local = jax.local_device_count()
     nproc = jax.process_count()
+    assert dp * tp == len(jax.devices()), \
+        f"multihost mesh must cover every global device: dp*tp=" \
+        f"{dp * tp} != {len(jax.devices())} (make_mesh takes the first " \
+        f"dp*tp devices, so a partial mesh would assign this process " \
+        f"rows living on another process's chips)"
     assert local % tp == 0, \
         f"tp={tp} must divide local device count {local} (a tensor-" \
         f"parallel row cannot straddle hosts: tp collectives ride ICI)"
@@ -94,41 +98,50 @@ def make_global(mesh: Mesh, local: Any) -> Any:
     return jax.tree.map(one, local)
 
 
-_LIMB = 1 << 20  # see global_sum
+_LIMB = 1 << 16  # see global_stats
 
 
-def _rows(mesh: Mesh, row_value: np.ndarray) -> Any:
-    """Each process fills its dp rows with row_value -> global [dp, ...]
-    array for a replicated-out reduction. Deterministic and identical
-    on every process."""
+_reduce_jits: dict[Mesh, Any] = {}
+
+
+def global_stats(mesh: Mesh, ready: float, idle: float,
+                 frames: float) -> tuple[bool, bool, float]:
+    """One packed per-round reduction: (all_ready, all_idle,
+    frames_total).
+
+    The lockstep round loop needs three global quantities per round;
+    issuing them as separate reductions would cost three sequential DCN
+    barrier round-trips, so they ride one [dp, 5] array through a
+    single cached jit (a fresh jax.jit wrapper per call would retrace
+    every round) that returns both the row-min (flags) and the row-sum
+    (frame limbs).
+
+    Exactness: f32 rounds integers above 2^24, and frame counts reach
+    billions at atari57 scale — a rounded-down global count would stall
+    the frame-budget termination forever. The per-process count
+    therefore rides as three base-2^16 limbs on ONE row per process
+    (zeros on its other rows, so limb sums scale with process count,
+    not dp): each limb < 2^16, so limb-sums stay exact through 256
+    processes and counts to 2^48, and the limbs recombine exactly in
+    Python ints. Flags tile across all the process's rows (min is
+    idempotent over copies).
+    """
+    v = int(frames)
+    flags = [ready, idle]
+    limbs = [(v >> 32) & 0xFFFF, (v >> 16) & 0xFFFF, v & 0xFFFF]
     start, stop = process_rows(mesh)
-    return make_global(
-        mesh, np.tile(row_value[None], (stop - start,) + (1,) *
-                      row_value.ndim))
-
-
-def global_sum(mesh: Mesh, value: float) -> float:
-    """Exact sum of each PROCESS's non-negative integer-valued scalar.
-
-    f32 device arrays round integers above 2^24 (frame counts reach
-    billions at atari57 scale, and a rounded-down global count would
-    stall the frame-budget termination forever), so the value rides as
-    two base-2^20 limbs — each limb and each limb-sum stays well inside
-    f32's exact-integer range for any sane process count — and the
-    limbs recombine exactly in Python ints."""
-    v = int(value)
-    limbs = np.asarray([v // _LIMB, v % _LIMB], np.float32)
-    arr = _rows(mesh, limbs)  # [dp, 2]
-    repl = NamedSharding(mesh, P())
-    fn = jax.jit(partial(jnp.sum, axis=0), out_shardings=repl)
-    start, stop = process_rows(mesh)
-    hi, lo = (np.asarray(fn(arr)) / (stop - start)).tolist()
-    return float(int(round(hi)) * _LIMB + int(round(lo)))
-
-
-def global_min(mesh: Mesh, value: float) -> float:
-    """Min of each process's scalar (used for 0/1 readiness flags)."""
-    arr = _rows(mesh, np.asarray([np.float32(value)]))
-    repl = NamedSharding(mesh, P())
-    fn = jax.jit(jnp.min, out_shardings=repl)
-    return float(fn(arr))
+    block = np.zeros((stop - start, 5), np.float32)
+    block[:, :2] = flags
+    block[0, 2:] = limbs
+    arr = make_global(mesh, block)
+    fn = _reduce_jits.get(mesh)
+    if fn is None:
+        repl = NamedSharding(mesh, P())
+        fn = jax.jit(lambda a: (jnp.min(a, axis=0), jnp.sum(a, axis=0)),
+                     out_shardings=(repl, repl))
+        _reduce_jits[mesh] = fn
+    mins, sums = fn(arr)
+    mins, sums = np.asarray(mins), np.asarray(sums)
+    l2, l1, l0 = (int(round(s)) for s in sums[2:])
+    total = float((l2 << 32) + (l1 << 16) + l0)
+    return bool(mins[0] >= 1.0), bool(mins[1] >= 1.0), total

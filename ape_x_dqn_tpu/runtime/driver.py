@@ -200,7 +200,9 @@ class ApexDriver:
             self._server_apply_fn(),
             server_params,
             max_batch=cfg.inference.max_batch,
-            deadline_ms=cfg.inference.deadline_ms)
+            deadline_ms=cfg.inference.deadline_ms,
+            mesh=self.mesh if (self.is_dist
+                               and cfg.inference.shard_over_mesh) else None)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
         # initial publication so remote actor hosts can bootstrap before

@@ -17,13 +17,14 @@ TPU-native shape of that design:
   arrays are collectives, so every process must issue the identical
   call sequence. Each round:
 
-      1. all processes agree (global_min) whether every host has a
-         full ingest block staged; if so, all call `add` together —
-         gating beats padding, because dead filler items would cycle
-         the replay ring and evict real experience on idle hosts;
+      1. all processes agree (the packed global_stats reduction)
+         whether every host has a full ingest block staged; if so, all
+         call `add` together — gating beats padding, because dead
+         filler items would cycle the replay ring and evict real
+         experience on idle hosts;
       2. the replay fill check, train_many dispatch, publication
          boundary, and termination all branch on GLOBAL values (jit
-         outputs or global_sum/min reductions), never on host-local
+         outputs or the global_stats reduction), never on host-local
          state.
 
   A host whose actors all die stalls global ingest (training continues
@@ -38,12 +39,14 @@ Run via the CLI:
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.configs import RunConfig
@@ -104,14 +107,17 @@ class MultihostApexDriver:
             item_spec = frame_segment_spec(
                 cfg.replay.seg_transitions, cfg.learner.n_step,
                 self.spec.obs_shape, self.spec.obs_dtype)
-            self._unit_items = cfg.replay.seg_transitions
             self._chunk = max(cfg.replay.segs_per_add, 1)
         else:
             item_spec = transition_item_spec(self.spec.obs_shape,
                                              self.spec.obs_dtype)
-            self._unit_items = 1
             self._chunk = max(cfg.actors.ingest_batch, 1)
         self._item_keys = tuple(item_spec.keys())
+        self._item_spec = item_spec
+        assert cfg.replay.kind == "prioritized", \
+            "the multihost learner requires prioritized replay (the " \
+            "per-shard sum-trees ARE the sharded state); got " \
+            f"replay.kind={cfg.replay.kind!r}"
 
         # identical construction on every process (same cfg.seed) ->
         # identical initial params; learner.init then shards them over
@@ -128,12 +134,20 @@ class MultihostApexDriver:
         # publication is a global collective (tp all-gather + cross-host
         # replication); the inference server's jit runs process-LOCALLY,
         # so it gets a host copy — a global array would not mix with the
-        # server's local inputs
+        # server's local inputs. With shard_over_mesh the server spreads
+        # query batches over THIS process's devices (a process-local
+        # mesh: only addressable devices, so its jit stays collective-
+        # free and cannot perturb the global lockstep).
+        local = jax.local_devices()
+        self._inference_mesh = (
+            make_mesh(dp=len(local), tp=1, devices=local)
+            if cfg.inference.shard_over_mesh and len(local) > 1 else None)
         server_params = self._host_params()
         self.server = BatchedInferenceServer(
             server_apply_fn(self.family, self.net), server_params,
             max_batch=cfg.inference.max_batch,
-            deadline_ms=cfg.inference.deadline_ms)
+            deadline_ms=cfg.inference.deadline_ms,
+            mesh=self._inference_mesh)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
         self.transport.publish_params(server_params, 0)
@@ -144,14 +158,22 @@ class MultihostApexDriver:
         self._grad_steps = 0
         self._stage: list[dict] = []
         self._stage_n = 0
+        self._actor_threads: list[threading.Thread] = []
         self._lock = threading.Lock()
         self.actor_errors: list[tuple[int, Exception]] = []
 
     def _host_params(self):
         """publish_params (collective, all processes call) -> host numpy
-        (valid per-process because the result is fully replicated)."""
+        (valid per-process because the result is fully replicated). In
+        sharded-inference mode the copy lands back on the local mesh
+        (replicated) so the server does not re-upload params from host
+        memory on every batch dispatch."""
         pub = self.learner.publish_params(self.state)
-        return jax.tree.map(np.asarray, pub)
+        host = jax.tree.map(np.asarray, pub)
+        if self._inference_mesh is not None:
+            host = jax.device_put(
+                host, NamedSharding(self._inference_mesh, P()))
+        return host
 
     # -- local actor plumbing (per host) ----------------------------------
 
@@ -161,8 +183,20 @@ class MultihostApexDriver:
 
     def _actor_thread(self, i: int, max_frames: int) -> None:
         try:
+            # distinct global actor identities per host: without the
+            # process offset every host's actor i would share seeds,
+            # eps_i, and (lockstep-identical) params — N hosts producing
+            # byte-identical trajectories has the data diversity of one.
+            # The eps_i schedule spans the num_actors * nproc fleet, the
+            # same convention as actor_host.py --actor-offset.
+            n_local = self.cfg.actors.num_actors
+            acfg = dataclasses.replace(
+                self.cfg, actors=dataclasses.replace(
+                    self.cfg.actors,
+                    num_actors=n_local * jax.process_count()))
             actor = actor_class(self.family)(
-                self.cfg, i, self.server.query, self.transport,
+                acfg, jax.process_index() * n_local + i,
+                self.server.query, self.transport,
                 episode_callback=self._on_episode)
             actor.run(max_frames, self.stop_event)
         except Exception as e:  # noqa: BLE001 - reported in run() output
@@ -171,8 +205,23 @@ class MultihostApexDriver:
 
     def _pump_ingest(self) -> None:
         """Drain the transport into the local stage (runs each round —
-        no separate ingest thread: the round loop owns the state)."""
-        while True:
+        no separate ingest thread: the round loop owns the state).
+
+        While producers are live the stage is capped at a few ingest
+        blocks: the round loop consumes at most one block per round, so
+        an uncapped pump would absorb everything actors produce during
+        train_many (unbounded host memory) and defeat the transport's
+        drop-oldest backpressure, which is where overflow is designed
+        to land. Once every producer is gone the cap lifts — leftover
+        queue contents are finite, and local_idle requires pending==0,
+        so a capped pump would leave this host unable to ever read
+        idle (fleet-wide livelock via the all_idle gate)."""
+        producers_live = (
+            any(t.is_alive() for t in self._actor_threads)
+            or getattr(self.transport, "active_connections", 0) > 0)
+        cap = 4 * self.dp_local * self._chunk if producers_live \
+            else float("inf")
+        while self._stage_n < cap:
             batch = self.transport.recv_experience(timeout=0.0)
             if batch is None:
                 return
@@ -201,6 +250,34 @@ class MultihostApexDriver:
     def _min_fill(self) -> int:
         return min(self.cfg.replay.min_fill, self.capacity // 2)
 
+    def _warmup(self, chunk_steps: int) -> None:
+        """AOT-compile the hot jits before actors start (same rationale
+        as ApexDriver._warmup: the first add/train_many dispatch
+        otherwise compiles for 20-40s inside the single-threaded round
+        loop, during which nothing pumps the bounded transport queue
+        and drop-oldest discards the early experience stream on every
+        host). Abstract ShapeDtypeStructs with the real shardings stand
+        in for the global ingest arrays — no cross-host data movement,
+        and every process lowers the identical program at the same
+        construction point."""
+        cls = type(self.learner)
+        sharding = NamedSharding(self.mesh, P("dp"))
+        ptail = (self.cfg.replay.seg_transitions,) if self._frame_mode \
+            else ()
+        items = jax.tree.map(
+            lambda t: jax.ShapeDtypeStruct(
+                (self.dp, self._chunk) + t.shape, t.dtype,
+                sharding=sharding),
+            self._item_spec)
+        pris = jax.ShapeDtypeStruct((self.dp, self._chunk) + ptail,
+                                    np.float32, sharding=sharding)
+        cls.add.lower(self.learner, self.state, items, pris).compile()
+        cls.train_many.lower(self.learner, self.state,
+                             chunk_steps).compile()
+        if chunk_steps > 1:
+            # the tail of a publish window dispatches single steps
+            cls.train_many.lower(self.learner, self.state, 1).compile()
+
     # -- the lockstep round loop ------------------------------------------
 
     def run(self, total_env_frames: int | None = None,
@@ -219,6 +296,14 @@ class MultihostApexDriver:
                                     args=(i, per_actor),
                                     name=f"actor-{i}", daemon=True)
                    for i in range(cfg.actors.num_actors)]
+        self._actor_threads = threads  # _pump_ingest's cap-lift check
+        try:
+            self._warmup(chunk_steps)
+        except (AttributeError, NotImplementedError) as e:
+            # AOT lowering genuinely unavailable: first dispatches
+            # compile lazily. Anything else is a real bug that must
+            # surface, not a degraded start (mirrors ApexDriver.run).
+            self.metrics.log(0, warmup_skipped=repr(e))
         self.server.warmup(warmup_example(self.family, cfg, self.spec))
         for t in threads:
             t.start()
@@ -234,10 +319,27 @@ class MultihostApexDriver:
         while True:
             self._pump_ingest()
             progressed = False
-            # 1. collective ingest, gated on EVERY host having a block
+            # 0. ONE packed collective for this round's global control
+            # values (three separate reductions would pay three
+            # sequential DCN barrier round-trips per round).
+            # `local_idle`: this host can never produce another ingest
+            # block — actors finished/dead, no live remote actor-host
+            # connections, transport drained. Deliberately independent
+            # of the stage: a host stranded with a full block that OTHER
+            # hosts can never match must still read as idle, or an
+            # asymmetric drain spins every process forever.
             blocks_ready = 1.0 if self._stage_n >= \
                 self.dp_local * self._chunk else 0.0
-            if multihost.global_min(self.mesh, blocks_ready) >= 1.0:
+            local_idle = 1.0 if (
+                not any(t.is_alive() for t in threads)
+                and getattr(self.transport, "active_connections", 0) == 0
+                and self.transport.pending == 0) else 0.0
+            with self._lock:
+                frames_local = self._frames_local
+            all_ready, all_idle, frames_global = multihost.global_stats(
+                self.mesh, blocks_ready, local_idle, float(frames_local))
+            # 1. collective ingest, gated on EVERY host having a block
+            if all_ready:
                 block = self._pop_block()
                 items = multihost.make_global(
                     self.mesh,
@@ -262,28 +364,31 @@ class MultihostApexDriver:
                     pub = self._host_params()
                     self.server.update_params(pub, self._grad_steps)
                     self.transport.publish_params(pub, self._grad_steps)
-            # 3. global termination — all conditions from global values.
-            # `local_idle`: this host can never ingest again (actors
-            # finished/dead, transport drained, stage short of a block) —
-            # guards against frame counts that never reach `total`
-            # (lossy-transport drops, per-actor truncation of the budget)
-            with self._lock:
-                frames_local = self._frames_local
-            frames_global = multihost.global_sum(self.mesh,
-                                                 float(frames_local))
-            local_idle = 1.0 if (not any(t.is_alive() for t in threads)
-                                 and self.transport.pending == 0
-                                 and blocks_ready < 1.0) else 0.0
-            all_idle = multihost.global_min(self.mesh, local_idle) >= 1.0
+                    with self._lock:
+                        returns = list(self.episode_returns)
+                    self.metrics.log(
+                        self._grad_steps, loss=loss, replay_filled=filled,
+                        frames_global=int(frames_global),
+                        frames_local=frames_local,
+                        avg_return=(float(np.mean(returns))
+                                    if returns else None))
+            # 3. global termination — all conditions derive from the
+            # round-start packed collective, so every process breaks on
+            # the same round. Guards against frame counts that never
+            # reach `total` (lossy-transport drops, per-actor truncation
+            # of the budget).
             if self._grad_steps >= max_grad_steps:
                 break
             if frames_global >= total and max_grad_steps >= 10**9:
                 break  # frame-budget run: actors are done
-            if all_idle and (max_grad_steps >= 10**9
-                             or filled < self._min_fill()):
-                # ingest can never resume anywhere; either there is no
-                # finite step target to chase, or training can never
-                # start — spinning helps nobody
+            if all_idle and not all_ready and (max_grad_steps >= 10**9
+                                               or filled
+                                               < self._min_fill()):
+                # no host can ever produce experience again and the
+                # ingest gate cannot fire (stranded partial blocks can
+                # never complete); either there is no finite step target
+                # to chase, or training can never start — spinning
+                # helps nobody
                 break
             if not progressed:
                 # idle round: don't hammer the coordination service

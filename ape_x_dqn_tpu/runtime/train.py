@@ -152,17 +152,23 @@ def main(argv: list[str] | None = None) -> int:
         cfg = cfg.replace(profile_dir=args.profile_dir)
     cfg = apply_overrides(cfg, args.set)
 
+    if args.coordinator is not None and cfg.checkpoint_dir:
+        # catches checkpoint_dir arriving via --set or a preset default,
+        # which the flag-level check above cannot see
+        parser.error("checkpoint_dir is not supported in multihost "
+                     "mode yet (set via --set or config preset)")
+
     metrics = Metrics(log_path=args.metrics_file)
+    transport = server = None
+    if args.listen and not args.single_process:
+        from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
+        host, port = args.listen.rsplit(":", 1)
+        server = transport = SocketIngestServer(host, int(port))
+        print(f"ingest listening on {host}:{server.port}",
+              file=sys.stderr, flush=True)
     if args.coordinator is not None:
         from ape_x_dqn_tpu.runtime.multihost_driver import (
             MultihostApexDriver)
-        transport = server = None
-        if args.listen:
-            from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
-            host, port = args.listen.rsplit(":", 1)
-            server = transport = SocketIngestServer(host, int(port))
-            print(f"ingest listening on {host}:{server.port}",
-                  file=sys.stderr, flush=True)
         driver = MultihostApexDriver(cfg, metrics=metrics,
                                      transport=transport)
         try:
@@ -175,14 +181,6 @@ def main(argv: list[str] | None = None) -> int:
         out = train_single_process(cfg, metrics=metrics)
     else:
         from ape_x_dqn_tpu.runtime.driver import ApexDriver
-        transport = None
-        server = None
-        if args.listen:
-            from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
-            host, port = args.listen.rsplit(":", 1)
-            server = transport = SocketIngestServer(host, int(port))
-            print(f"ingest listening on {host}:{server.port}",
-                  file=sys.stderr, flush=True)
         driver = ApexDriver(cfg, metrics=metrics, transport=transport)
         try:
             out = driver.run(max_grad_steps=args.max_grad_steps,

@@ -68,23 +68,13 @@ def test_frame_budget_terminates_when_total_unreachable():
     assert outs[0]["frames"] > 0
 
 
-def test_two_process_lockstep_training():
+def test_two_process_lockstep_training(tmp_path):
     port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        # 4 local devices per process -> dp=8 rows across two processes
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "ape_x_dqn_tpu.runtime.train",
-             "--config", "cartpole_smoke",
-             "--coordinator", f"127.0.0.1:{port}",
-             "--num-processes", "2", "--process-id", str(pid),
-             "--total-env-frames", "1600", "--max-grad-steps", "20"]
-            + [a for s in _SETS for a in ("--set", s)],
-            env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    procs = [_launch(port, pid,
+                     ["--total-env-frames", "1600",
+                      "--max-grad-steps", "20",
+                      "--metrics-file", str(tmp_path / f"m{pid}.jsonl")])
+             for pid in range(2)]
     outs = []
     for p in procs:
         stdout, stderr = p.communicate(timeout=540)
@@ -102,3 +92,8 @@ def test_two_process_lockstep_training():
     assert outs[0]["loss"] == pytest.approx(outs[1]["loss"], rel=1e-5)
     # both hosts actually contributed experience
     assert outs[0]["frames_local"] > 0 and outs[1]["frames_local"] > 0
+    # per-round metrics stream to --metrics-file (publish cadence)
+    for pid in range(2):
+        lines = (tmp_path / f"m{pid}.jsonl").read_text().splitlines()
+        recs = [json.loads(ln) for ln in lines]
+        assert any("loss" in r for r in recs), recs
