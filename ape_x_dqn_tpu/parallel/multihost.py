@@ -98,9 +98,6 @@ def make_global(mesh: Mesh, local: Any) -> Any:
     return jax.tree.map(one, local)
 
 
-_LIMB = 1 << 16  # see global_stats
-
-
 _reduce_jits: dict[Mesh, Any] = {}
 
 

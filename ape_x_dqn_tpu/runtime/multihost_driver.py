@@ -45,6 +45,7 @@ import time
 from collections import deque
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -52,11 +53,13 @@ from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
-from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
+from ape_x_dqn_tpu.parallel.dist_learner import (
+    DistDQNLearner, DistSequenceLearner)
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
 from ape_x_dqn_tpu.parallel import multihost
 from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
+from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
 from ape_x_dqn_tpu.runtime.driver import build_prioritized_replay
 from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, server_apply_fn, warmup_example)
@@ -69,12 +72,12 @@ from ape_x_dqn_tpu.utils.rng import component_key
 class MultihostApexDriver:
     """Synchronous-round Ape-X driver; one instance per learner process.
 
-    Supports the flat-DQN family (both storage layouts). The recurrent
-    and continuous families run multi-host today by putting their
-    ACTORS on remote hosts (runtime/actor_host.py) against a
-    single-process learner; extending this lockstep loop to them is
-    mechanical (same learners, same round protocol) once a workload
-    needs it.
+    Supports the flat-DQN family (both storage layouts) and the
+    recurrent R2D2 family (stored-state sequence replay, both item
+    layouts). The continuous DPG family runs multi-host today by
+    putting its ACTORS on remote hosts (runtime/actor_host.py) against
+    a single-process learner — its nets are small enough that a
+    sharded learner buys nothing (see ApexDriver's matching gate).
     """
 
     def __init__(self, cfg: RunConfig, metrics: Metrics | None = None,
@@ -84,39 +87,66 @@ class MultihostApexDriver:
             "for single-process runs)"
         self.cfg = cfg
         self.family = family_of(cfg)
-        if self.family != "dqn":
+        if self.family == "dpg":
             raise NotImplementedError(
-                "multihost lockstep loop covers the flat-DQN family; "
-                "run r2d2/dpg learners single-process with remote actor "
-                "hosts (runtime/actor_host.py)")
+                "the multihost lockstep loop covers the DQN and R2D2 "
+                "families; DPG nets are small — run the learner "
+                "single-process with remote actor hosts "
+                "(runtime/actor_host.py)")
         self.metrics = metrics or Metrics()
         probe_env = make_env(cfg.env, seed=cfg.seed)
         self.spec = probe_env.spec
         self.net = build_network(cfg.network, self.spec)
         obs0 = probe_env.reset()
-        params = self.net.init(component_key(cfg.seed, "net_init"),
-                               obs0[None])
 
         self.mesh = make_mesh(dp=cfg.parallel.dp, tp=cfg.parallel.tp)
         self.row_start, self.row_stop = multihost.process_rows(self.mesh)
         self.dp = cfg.parallel.dp
         self.dp_local = self.row_stop - self.row_start
 
-        self._frame_mode = cfg.replay.storage == "frame_ring"
-        if self._frame_mode:
+        # storage/items per family, mirroring ApexDriver: frame-ring
+        # changes the ITEM layout for r2d2 (single frames per sequence)
+        # but only the dqn family swaps the replay class + segment
+        # staging
+        self._frame_mode = (cfg.replay.storage == "frame_ring"
+                            and self.family == "dqn")
+        if self.family == "r2d2":
+            z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
+            params = self.net.init(component_key(cfg.seed, "net_init"),
+                                   obs0[None, None], (z, z))
+            seq_frame_mode = cfg.replay.storage == "frame_ring"
+            if seq_frame_mode and len(self.spec.obs_shape) != 3:
+                raise ValueError(
+                    f"frame_ring sequence storage needs [H, W, stack] "
+                    f"pixel obs, got {self.spec.obs_shape}; set "
+                    f"replay.storage='flat' for vector observations")
+            item_spec = sequence_item_spec(
+                self.spec.obs_shape, self.spec.obs_dtype,
+                cfg.replay.seq_length, cfg.network.lstm_size,
+                frame_mode=seq_frame_mode)
+            # staging units are whole sequences; ingest_batch counts
+            # TRANSITIONS (see ApexDriver's matching comment)
+            self._chunk = max(
+                cfg.actors.ingest_batch // cfg.replay.seq_length, 1)
+        elif self._frame_mode:
+            params = self.net.init(component_key(cfg.seed, "net_init"),
+                                   obs0[None])
             item_spec = frame_segment_spec(
                 cfg.replay.seg_transitions, cfg.learner.n_step,
                 self.spec.obs_shape, self.spec.obs_dtype)
             self._chunk = max(cfg.replay.segs_per_add, 1)
         else:
+            params = self.net.init(component_key(cfg.seed, "net_init"),
+                                   obs0[None])
             item_spec = transition_item_spec(self.spec.obs_shape,
                                              self.spec.obs_dtype)
             self._chunk = max(cfg.actors.ingest_batch, 1)
         self._item_keys = tuple(item_spec.keys())
         self._item_spec = item_spec
-        assert cfg.replay.kind == "prioritized", \
+        assert cfg.replay.kind in ("prioritized", "sequence"), \
             "the multihost learner requires prioritized replay (the " \
-            "per-shard sum-trees ARE the sharded state); got " \
+            "per-shard sum-trees ARE the sharded state; kind='sequence' " \
+            "for R2D2); got " \
             f"replay.kind={cfg.replay.kind!r}"
 
         # identical construction on every process (same cfg.seed) ->
@@ -126,8 +156,13 @@ class MultihostApexDriver:
         self.replay = build_prioritized_replay(cfg, self.spec, shard_cap,
                                                self._frame_mode)
         self.capacity = shard_cap * self.dp
-        self.learner = DistDQNLearner(self.net.apply, self.replay,
-                                      cfg.learner, self.mesh)
+        if self.family == "r2d2":
+            self.learner = DistSequenceLearner(
+                lambda p, o, s: self.net.apply(p, o, s),
+                self.replay, cfg.learner, cfg.replay, self.mesh)
+        else:
+            self.learner = DistDQNLearner(self.net.apply, self.replay,
+                                          cfg.learner, self.mesh)
         self.state = self.learner.init(
             params, item_spec, component_key(cfg.seed, "learner"))
 
@@ -159,6 +194,7 @@ class MultihostApexDriver:
         self._stage: list[dict] = []
         self._stage_n = 0
         self._actor_threads: list[threading.Thread] = []
+        self._saw_remote = False  # first remote actor-host connection
         self._lock = threading.Lock()
         self.actor_errors: list[tuple[int, Exception]] = []
 
@@ -216,9 +252,11 @@ class MultihostApexDriver:
         queue contents are finite, and local_idle requires pending==0,
         so a capped pump would leave this host unable to ever read
         idle (fleet-wide livelock via the all_idle gate)."""
+        conns = getattr(self.transport, "active_connections", 0)
+        if conns > 0:
+            self._saw_remote = True
         producers_live = (
-            any(t.is_alive() for t in self._actor_threads)
-            or getattr(self.transport, "active_connections", 0) > 0)
+            any(t.is_alive() for t in self._actor_threads) or conns > 0)
         cap = 4 * self.dp_local * self._chunk if producers_live \
             else float("inf")
         while self._stage_n < cap:
@@ -330,8 +368,17 @@ class MultihostApexDriver:
             # asymmetric drain spins every process forever.
             blocks_ready = 1.0 if self._stage_n >= \
                 self.dp_local * self._chunk else 0.0
+            # boot grace: a host with NO local actors whose listening
+            # transport has never seen a remote actor-host must not
+            # read idle — at startup active_connections == 0 only
+            # because producers are still booting, and an idle verdict
+            # would terminate the fleet on round 1 with 0 grad steps
+            booting = (cfg.actors.num_actors == 0
+                       and hasattr(self.transport, "active_connections")
+                       and not self._saw_remote)
             local_idle = 1.0 if (
-                not any(t.is_alive() for t in threads)
+                not booting
+                and not any(t.is_alive() for t in threads)
                 and getattr(self.transport, "active_connections", 0) == 0
                 and self.transport.pending == 0) else 0.0
             with self._lock:

@@ -32,18 +32,18 @@ _SETS = [
 ]
 
 
-def _launch(port, pid, extra):
+def _launch(port, pid, extra, config="cartpole_smoke", sets=_SETS):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # 4 local devices per process -> dp=8 rows across two processes
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     return subprocess.Popen(
         [sys.executable, "-m", "ape_x_dqn_tpu.runtime.train",
-         "--config", "cartpole_smoke",
+         "--config", config,
          "--coordinator", f"127.0.0.1:{port}",
          "--num-processes", "2", "--process-id", str(pid)]
-        + [a for s in _SETS for a in ("--set", s)]
-        + extra,  # after _SETS: later --set wins
+        + [a for s in sets for a in ("--set", s)]
+        + extra,  # after sets: later --set wins
         env=env, cwd=os.path.dirname(os.path.dirname(__file__)),
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
 
@@ -97,3 +97,45 @@ def test_two_process_lockstep_training(tmp_path):
         lines = (tmp_path / f"m{pid}.jsonl").read_text().splitlines()
         recs = [json.loads(ln) for ln in lines]
         assert any("loss" in r for r in recs), recs
+
+
+_R2D2_SETS = [
+    "parallel.dp=8", "parallel.tp=1",
+    "env.id=CartPolePO", "env.kind=cartpole_po",
+    "network.lstm_size=32", "network.torso_dense=64",
+    "network.compute_dtype=float32",
+    "replay.capacity=512", "replay.seq_length=16", "replay.seq_overlap=8",
+    "replay.burn_in=4", "replay.min_fill=16", "replay.storage=flat",
+    "learner.batch_size=16", "learner.n_step=3", "learner.lr=1e-3",
+    "learner.target_sync_every=100", "learner.publish_every=10",
+    "learner.train_chunk=2",
+    "actors.num_actors=1", "actors.base_eps=0.4", "actors.ingest_batch=64",
+    "inference.max_batch=8", "inference.deadline_ms=1.0",
+    "eval_every_steps=0", "eval_episodes=0",
+]
+
+
+def test_two_process_lockstep_r2d2():
+    """R2D2 over the lockstep round loop: two OS processes, sequence
+    replay shards + the LSTM sequence loss on one global 8-device mesh,
+    recurrent actors querying stateful {obs,c,h} inference."""
+    port = _free_port()
+    procs = [_launch(port, pid,
+                     ["--total-env-frames", "2400",
+                      "--max-grad-steps", "10"],
+                     config="r2d2", sets=_R2D2_SETS)
+             for pid in range(2)]
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=540)
+        assert p.returncode == 0, stderr[-3000:]
+        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    for out in outs:
+        assert out["grad_steps"] >= 10, out
+        assert out["actor_errors"] == [], out
+        assert out["frames"] > 0
+    # lockstep invariants hold for the sequence learner too
+    assert outs[0]["grad_steps"] == outs[1]["grad_steps"]
+    assert outs[0]["frames"] == outs[1]["frames"]
+    assert outs[0]["loss"] == pytest.approx(outs[1]["loss"], rel=1e-5)
+    assert outs[0]["frames_local"] > 0 and outs[1]["frames_local"] > 0
