@@ -1,58 +1,42 @@
 """ctypes bindings for the native framing codec (cpp/framing.cpp).
 
-The .so is compiled lazily with g++ the first time it's needed and
-cached next to the source; if no compiler is available the pure-Python
-fallbacks (zlib.crc32 + bytes joins) are wire-compatible, so a
-C++-enabled learner host can talk to a Python-only actor host.
+Compiled lazily via utils/native_build.py; if no compiler is available
+the pure-Python fallbacks (zlib.crc32 + bytes joins) are
+wire-compatible, so a C++-enabled learner host can talk to a
+Python-only actor host.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 import zlib
+
+from ape_x_dqn_tpu.utils.native_build import build_and_load
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "cpp", "framing.cpp")
 _SO = os.path.join(os.path.dirname(_SRC), "libapex_framing.so")
 
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_tried = False
-
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _tried
-    with _lock:
-        if _tried:
-            return _lib
-        _tried = True
-        try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
-                    check=True, capture_output=True, timeout=120)
-            lib = ctypes.CDLL(_SO)
-            lib.apex_crc32.restype = ctypes.c_uint32
-            lib.apex_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                       ctypes.c_uint32]
-            lib.apex_pack.restype = ctypes.c_uint64
-            lib.apex_pack.argtypes = [
-                ctypes.c_void_p,
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
-            lib.apex_unpack_offsets.restype = ctypes.c_uint64
-            lib.apex_unpack_offsets.argtypes = [
-                ctypes.c_char_p, ctypes.c_uint64,
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
-            _lib = lib
-        except Exception:
-            _lib = None  # no toolchain: Python fallback
-        return _lib
+    lib = build_and_load(_SRC, _SO)
+    if lib is not None:
+        # idempotent; build_and_load caches the CDLL per process
+        lib.apex_crc32.restype = ctypes.c_uint32
+        lib.apex_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_uint32]
+        lib.apex_pack.restype = ctypes.c_uint64
+        lib.apex_pack.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+        lib.apex_unpack_offsets.restype = ctypes.c_uint64
+        lib.apex_unpack_offsets.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+    return lib
 
 
 def have_native() -> bool:

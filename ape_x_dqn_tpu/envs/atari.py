@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ape_x_dqn_tpu.envs import native
 from ape_x_dqn_tpu.envs.base import Env, EnvSpec
 
 try:  # real ALE if the user's environment has it
@@ -236,12 +237,20 @@ class AtariPreprocessing(Env):
         self._rng = np.random.default_rng(seed)
         self._raw.seed(seed)
 
-    def _observe(self, frame_max: np.ndarray) -> np.ndarray:
-        g = grayscale(frame_max)
-        small = np.clip(bilinear_resize(g, self._size, self._size), 0, 255)
+    def _observe(self, f0: np.ndarray,
+                 f1: np.ndarray | None = None) -> np.ndarray:
+        """max(f0, f1) -> gray -> resize -> stack shift. The fused C++
+        kernel (cpp/preproc.cpp via envs/native.py) and the numpy path
+        are bit-identical (tested); the native one skips the per-frame
+        float intermediates that dominate the actor's env-step cost."""
+        small = native.preproc(f0, f1, self._size, self._size)
+        if small is None:
+            fm = f0 if f1 is None else np.maximum(f0, f1)
+            g = grayscale(fm)
+            small = np.clip(bilinear_resize(g, self._size, self._size),
+                            0, 255).astype(np.uint8)
         self._frames = np.concatenate(
-            [self._frames[..., 1:], small.astype(np.uint8)[..., None]],
-            axis=-1)
+            [self._frames[..., 1:], small[..., None]], axis=-1)
         return self._frames.copy()
 
     def reset(self) -> np.ndarray:
@@ -288,11 +297,6 @@ class AtariPreprocessing(Env):
         self._raw_done = raw_done
         self._ep_return += total_reward
 
-        if last2[0] is None:
-            frame_max = last2[1]
-        else:
-            frame_max = np.maximum(last2[0], last2[1])
-
         life_lost = self._raw.lives < self._lives
         self._lives = self._raw.lives
         truncated = self._elapsed >= self._max_frames
@@ -301,7 +305,7 @@ class AtariPreprocessing(Env):
         terminal = raw_done or (self._episodic_life and life_lost)
 
         reward = float(np.sign(total_reward)) if self._clip else total_reward
-        obs = self._observe(frame_max)
+        obs = self._observe(last2[1], last2[0])
         info: dict = {"terminal": terminal, "lives": self._lives,
                       "raw_reward": total_reward}
         if raw_done or truncated:

@@ -33,16 +33,14 @@ from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
-from ape_x_dqn_tpu.replay.frame_ring import (
-    FrameRingReplay, frame_segment_spec)
+from ape_x_dqn_tpu.replay.frame_ring import FrameRingReplay
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
-from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
 from ape_x_dqn_tpu.runtime.family import (
-    actor_class, family_of, server_apply_fn, warmup_example)
-from ape_x_dqn_tpu.runtime.dpg_learner import (
-    DPGLearner, continuous_item_spec)
+    actor_class, family_of, family_setup, server_apply_fn,
+    warmup_example)
+from ape_x_dqn_tpu.runtime.dpg_learner import DPGLearner
 from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
-from ape_x_dqn_tpu.runtime.learner import DQNLearner, transition_item_spec
+from ape_x_dqn_tpu.runtime.learner import DQNLearner
 from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 from ape_x_dqn_tpu.runtime.single_process import build_replay
 from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
@@ -81,57 +79,13 @@ class ApexDriver:
         self.net = build_network(cfg.network, self.spec)
         obs0 = probe_env.reset()
         # model family: flat-transition DQN, stored-state sequences (R2D2),
-        # or continuous-control actor-critic (Ape-X DPG)
+        # or continuous-control actor-critic (Ape-X DPG). family_setup
+        # owns params init + replay item layout + staging geometry
+        # (shared with the multihost driver).
         self.family = family_of(cfg)
-        if self.family == "r2d2":
-            z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
-            params = self.net.init(component_key(cfg.seed, "net_init"),
-                                   obs0[None, None], (z, z))
-            seq_frame_mode = cfg.replay.storage == "frame_ring"
-            if seq_frame_mode and len(self.spec.obs_shape) != 3:
-                raise ValueError(
-                    f"frame_ring sequence storage needs [H, W, stack] "
-                    f"pixel obs, got {self.spec.obs_shape}; set "
-                    f"replay.storage='flat' for vector observations")
-            item_spec = sequence_item_spec(
-                self.spec.obs_shape, self.spec.obs_dtype,
-                cfg.replay.seq_length, cfg.network.lstm_size,
-                frame_mode=seq_frame_mode)
-        elif self.family == "dpg":
-            actor_net, critic_net = self.net
-            a0 = jnp.zeros((1, self.spec.action_dim), jnp.float32)
-            params = (
-                actor_net.init(component_key(cfg.seed, "actor_init"),
-                               obs0[None]),
-                critic_net.init(component_key(cfg.seed, "critic_init"),
-                                obs0[None], a0))
-            item_spec = continuous_item_spec(
-                self.spec.obs_shape, self.spec.obs_dtype,
-                self.spec.action_dim)
-        else:
-            params = self.net.init(component_key(cfg.seed, "net_init"),
-                                   obs0[None])
-            item_spec = transition_item_spec(self.spec.obs_shape,
-                                             self.spec.obs_dtype)
-        # frame_ring storage: single-frame pixel layouts. For the flat
-        # family it switches the replay class + segment staging
-        # (_frame_mode below); for r2d2 it only changes the sequence item
-        # content (single frames, rebuilt by batch_to_sequence_batch) —
-        # same replay, same staging. DPG obs are low-dimensional.
-        if cfg.replay.storage == "frame_ring" and self.family == "dpg":
-            raise NotImplementedError(
-                "frame_ring storage is for pixel families (dqn/r2d2); "
-                "use storage='flat' for dpg")
-        self._frame_mode = (cfg.replay.storage == "frame_ring"
-                            and self.family == "dqn")
-        if self._frame_mode:
-            if cfg.replay.kind != "prioritized":
-                raise NotImplementedError(
-                    "flat-family frame_ring storage requires "
-                    "prioritized replay")
-            item_spec = frame_segment_spec(
-                cfg.replay.seg_transitions, cfg.learner.n_step,
-                self.spec.obs_shape, self.spec.obs_dtype)
+        setup = family_setup(cfg, self.spec, self.net, obs0)
+        params, item_spec = setup.params, setup.item_spec
+        self._frame_mode = setup.frame_mode
         self._item_keys = tuple(item_spec.keys())
         self.dp = cfg.parallel.dp
         self.is_dist = cfg.parallel.dp * cfg.parallel.tp > 1
@@ -234,22 +188,8 @@ class ApexDriver:
         # seg_transitions transitions (frame-ring storage).
         self._stage: list[dict] = []
         self._stage_n = 0
-        if self._frame_mode:
-            self._stage_chunk = max(cfg.replay.segs_per_add, 1)
-            self._unit_items = cfg.replay.seg_transitions
-        elif self.family == "r2d2":
-            # staging units are whole sequences; ingest_batch counts
-            # TRANSITIONS, so a sequence chunk must scale down by the
-            # sequence length (the actor ships in the same group size) —
-            # otherwise a [dp, ingest_batch] block of SEQUENCES holds
-            # dp*ingest_batch*seq_length env steps and the learner
-            # starves waiting for the first add
-            self._stage_chunk = max(
-                cfg.actors.ingest_batch // cfg.replay.seq_length, 1)
-            self._unit_items = 1
-        else:
-            self._stage_chunk = max(cfg.actors.ingest_batch, 1)
-            self._unit_items = 1
+        self._stage_chunk = setup.stage_chunk
+        self._unit_items = setup.unit_items
         self._stage_dropped = 0
         self._item_spec = item_spec
         # profiler capture state: False = armed, True = tracing,

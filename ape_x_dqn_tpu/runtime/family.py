@@ -11,13 +11,17 @@ here once.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, NamedTuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
+from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
 from ape_x_dqn_tpu.runtime.actor import (
     Actor, ContinuousActor, RecurrentActor)
+from ape_x_dqn_tpu.utils.rng import component_key
 
 
 def family_of(cfg: RunConfig) -> str:
@@ -62,3 +66,88 @@ def warmup_example(family: str, cfg: RunConfig, spec: Any) -> Any:
         z = np.zeros(cfg.network.lstm_size, np.float32)
         return {"obs": obs, "c": z, "h": z}
     return obs
+
+
+class FamilySetup(NamedTuple):
+    """Per-family initial params, replay item layout, and ingest
+    staging geometry — one source of truth for ApexDriver and
+    MultihostApexDriver (they must agree with each other and with the
+    actors shipping the items)."""
+    params: Any
+    item_spec: dict
+    frame_mode: bool     # dqn family storing single-frame segments
+    stage_chunk: int     # staging units per [dp-row] ingest block
+    unit_items: int      # transitions per staging unit (fill counting)
+
+
+def family_setup(cfg: RunConfig, spec: Any, net: Any,
+                 obs0: np.ndarray) -> FamilySetup:
+    """Initialize params and pick the replay item layout + staging
+    chunk for cfg's family.
+
+    frame_ring storage selects single-frame pixel layouts: for the
+    flat-dqn family it swaps the item spec to whole frame segments
+    (and the driver swaps the replay class); for r2d2 it only changes
+    the sequence item content (single frames, stacks rebuilt in the
+    learner jit) — same replay, same staging. DPG obs are
+    low-dimensional, so frame_ring is rejected there.
+
+    Staging units are transitions (flat), frame segments (frame mode),
+    or whole sequences (r2d2) — for r2d2 the chunk scales ingest_batch
+    down by seq_length because ingest_batch counts TRANSITIONS, and a
+    [dp, ingest_batch] block of SEQUENCES would hold
+    dp*ingest_batch*seq_length env steps and starve the learner
+    waiting for the first add.
+    """
+    from ape_x_dqn_tpu.runtime.dpg_learner import continuous_item_spec
+    from ape_x_dqn_tpu.runtime.learner import transition_item_spec
+
+    family = family_of(cfg)
+    if family == "r2d2":
+        z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
+        params = net.init(component_key(cfg.seed, "net_init"),
+                          obs0[None, None], (z, z))
+        seq_frame_mode = cfg.replay.storage == "frame_ring"
+        if seq_frame_mode and len(spec.obs_shape) != 3:
+            raise ValueError(
+                f"frame_ring sequence storage needs [H, W, stack] "
+                f"pixel obs, got {spec.obs_shape}; set "
+                f"replay.storage='flat' for vector observations")
+        item_spec = sequence_item_spec(
+            spec.obs_shape, spec.obs_dtype, cfg.replay.seq_length,
+            cfg.network.lstm_size, frame_mode=seq_frame_mode)
+        return FamilySetup(
+            params, item_spec, False,
+            max(cfg.actors.ingest_batch // cfg.replay.seq_length, 1), 1)
+    if family == "dpg":
+        if cfg.replay.storage == "frame_ring":
+            raise NotImplementedError(
+                "frame_ring storage is for pixel families (dqn/r2d2); "
+                "use storage='flat' for dpg")
+        actor_net, critic_net = net
+        a0 = jnp.zeros((1, spec.action_dim), jnp.float32)
+        params = (
+            actor_net.init(component_key(cfg.seed, "actor_init"),
+                           obs0[None]),
+            critic_net.init(component_key(cfg.seed, "critic_init"),
+                            obs0[None], a0))
+        item_spec = continuous_item_spec(spec.obs_shape, spec.obs_dtype,
+                                         spec.action_dim)
+        return FamilySetup(params, item_spec, False,
+                           max(cfg.actors.ingest_batch, 1), 1)
+    # flat dqn
+    params = net.init(component_key(cfg.seed, "net_init"), obs0[None])
+    if cfg.replay.storage == "frame_ring":
+        if cfg.replay.kind != "prioritized":
+            raise NotImplementedError(
+                "flat-family frame_ring storage requires prioritized "
+                "replay")
+        item_spec = frame_segment_spec(
+            cfg.replay.seg_transitions, cfg.learner.n_step,
+            spec.obs_shape, spec.obs_dtype)
+        return FamilySetup(params, item_spec, True,
+                           max(cfg.replay.segs_per_add, 1),
+                           cfg.replay.seg_transitions)
+    item_spec = transition_item_spec(spec.obs_shape, spec.obs_dtype)
+    return FamilySetup(params, item_spec, False,
+                       max(cfg.actors.ingest_batch, 1), 1)

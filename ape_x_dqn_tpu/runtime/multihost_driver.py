@@ -45,7 +45,6 @@ import time
 from collections import deque
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -58,12 +57,10 @@ from ape_x_dqn_tpu.parallel.dist_learner import (
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
 from ape_x_dqn_tpu.parallel import multihost
-from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
-from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
 from ape_x_dqn_tpu.runtime.driver import build_prioritized_replay
 from ape_x_dqn_tpu.runtime.family import (
-    actor_class, family_of, server_apply_fn, warmup_example)
-from ape_x_dqn_tpu.runtime.learner import transition_item_spec
+    actor_class, family_of, family_setup, server_apply_fn,
+    warmup_example)
 from ape_x_dqn_tpu.utils.metrics import Metrics
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
@@ -104,43 +101,12 @@ class MultihostApexDriver:
         self.dp = cfg.parallel.dp
         self.dp_local = self.row_stop - self.row_start
 
-        # storage/items per family, mirroring ApexDriver: frame-ring
-        # changes the ITEM layout for r2d2 (single frames per sequence)
-        # but only the dqn family swaps the replay class + segment
-        # staging
-        self._frame_mode = (cfg.replay.storage == "frame_ring"
-                            and self.family == "dqn")
-        if self.family == "r2d2":
-            z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
-            params = self.net.init(component_key(cfg.seed, "net_init"),
-                                   obs0[None, None], (z, z))
-            seq_frame_mode = cfg.replay.storage == "frame_ring"
-            if seq_frame_mode and len(self.spec.obs_shape) != 3:
-                raise ValueError(
-                    f"frame_ring sequence storage needs [H, W, stack] "
-                    f"pixel obs, got {self.spec.obs_shape}; set "
-                    f"replay.storage='flat' for vector observations")
-            item_spec = sequence_item_spec(
-                self.spec.obs_shape, self.spec.obs_dtype,
-                cfg.replay.seq_length, cfg.network.lstm_size,
-                frame_mode=seq_frame_mode)
-            # staging units are whole sequences; ingest_batch counts
-            # TRANSITIONS (see ApexDriver's matching comment)
-            self._chunk = max(
-                cfg.actors.ingest_batch // cfg.replay.seq_length, 1)
-        elif self._frame_mode:
-            params = self.net.init(component_key(cfg.seed, "net_init"),
-                                   obs0[None])
-            item_spec = frame_segment_spec(
-                cfg.replay.seg_transitions, cfg.learner.n_step,
-                self.spec.obs_shape, self.spec.obs_dtype)
-            self._chunk = max(cfg.replay.segs_per_add, 1)
-        else:
-            params = self.net.init(component_key(cfg.seed, "net_init"),
-                                   obs0[None])
-            item_spec = transition_item_spec(self.spec.obs_shape,
-                                             self.spec.obs_dtype)
-            self._chunk = max(cfg.actors.ingest_batch, 1)
+        # family_setup (runtime/family.py) owns params init + replay
+        # item layout + staging geometry, shared with ApexDriver
+        setup = family_setup(cfg, self.spec, self.net, obs0)
+        params, item_spec = setup.params, setup.item_spec
+        self._frame_mode = setup.frame_mode
+        self._chunk = setup.stage_chunk
         self._item_keys = tuple(item_spec.keys())
         self._item_spec = item_spec
         assert cfg.replay.kind in ("prioritized", "sequence"), \
@@ -372,10 +338,16 @@ class MultihostApexDriver:
             # transport has never seen a remote actor-host must not
             # read idle — at startup active_connections == 0 only
             # because producers are still booting, and an idle verdict
-            # would terminate the fleet on round 1 with 0 grad steps
+            # would terminate the fleet on round 1 with 0 grad steps.
+            # Bounded (5 min): an actor-host job that never launches
+            # must not pin the whole fleet in the round loop forever.
+            # The deadline is host-local wall clock, which is safe —
+            # it only changes this host's REPORTED flag, not the
+            # collective call sequence.
             booting = (cfg.actors.num_actors == 0
                        and hasattr(self.transport, "active_connections")
-                       and not self._saw_remote)
+                       and not self._saw_remote
+                       and time.monotonic() - t0 < 300.0)
             local_idle = 1.0 if (
                 not booting
                 and not any(t.is_alive() for t in threads)

@@ -1,0 +1,74 @@
+"""Shared lazy build-and-load for the native C++ components.
+
+The runtime's native pieces (cpp/framing.cpp wire codec, cpp/preproc.cpp
+observation kernel) compile with g++ on first use and cache the .so next
+to the source; without a toolchain the callers fall back to numpy/zlib
+paths that are wire/bit compatible. This module owns the
+concurrency-sensitive scaffolding once — per-pid temp + atomic rename
+(concurrent first use across processes must not cache a corrupt .so),
+temp cleanup on failed/timed-out compiles, mtime staleness, one-shot
+caching — so the per-component bindings don't each re-implement it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import platform
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL | None] = {}
+
+
+def build_and_load(src: str, so: str,
+                   flags: tuple[str, ...] = ()) -> ctypes.CDLL | None:
+    """Compile src -> so with g++ (if missing/stale) and dlopen it.
+
+    Returns None when no compiler is available or the build fails —
+    callers fall back to their pure-Python implementations. The result
+    (including None) is cached per so-path for the process lifetime.
+    """
+    with _lock:
+        if so in _cache:
+            return _cache[so]
+        lib = None
+        tmp = f"{so}.{os.getpid()}"
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                subprocess.run(
+                    ["g++", "-O3", *flags, "-shared", "-fPIC",
+                     src, "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        finally:
+            try:
+                os.unlink(tmp)  # leftover from a failed/killed compile
+            except OSError:
+                pass
+        _cache[so] = lib
+        return lib
+
+
+def machine_tag() -> str:
+    """Stable per-CPU-model tag for arch-specific builds.
+
+    -march=native binaries cached on a shared filesystem (NFS home,
+    cluster checkout) would SIGILL on hosts with a different ISA —
+    CDLL succeeds, so no graceful fallback fires. Embedding this tag
+    in the .so name gives identical CPUs a shared cache and everything
+    else its own build.
+    """
+    try:
+        with open("/proc/cpuinfo") as fh:
+            lines = {ln for ln in fh
+                     if ln.startswith(("model name", "flags"))}
+        return hashlib.md5("".join(sorted(lines)).encode()).hexdigest()[:8]
+    except OSError:
+        return platform.machine() or "generic"

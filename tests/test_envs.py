@@ -200,3 +200,27 @@ def test_vector_env_keeps_terminal_obs():
             break
     else:
         raise AssertionError("no episode ended")
+
+
+def test_native_preproc_matches_numpy():
+    """The fused C++ observation kernel (cpp/preproc.cpp) must be
+    bit-identical to the numpy grayscale+bilinear_resize path, so the
+    two are interchangeable mid-run (envs/atari.py _observe)."""
+    from ape_x_dqn_tpu.envs import native
+    from ape_x_dqn_tpu.envs.atari import bilinear_resize, grayscale
+
+    if not native.available():
+        pytest.skip("no g++ toolchain for the native kernel")
+    rng = np.random.default_rng(0)
+    for h, w, out in [(210, 160, 84), (64, 48, 84), (84, 84, 84),
+                      (37, 91, 10)]:
+        f0 = rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+        f1 = rng.integers(0, 256, (h, w, 3)).astype(np.uint8)
+        # pair (max-pooled) and single-frame calls
+        for a, b in [(f0, f1), (f0, None)]:
+            fm = a if b is None else np.maximum(a, b)
+            ref = np.clip(bilinear_resize(grayscale(fm), out, out),
+                          0, 255).astype(np.uint8)
+            got = native.preproc(a, b, out, out)
+            np.testing.assert_array_equal(got, ref,
+                                          err_msg=f"{h}x{w}->{out}")
