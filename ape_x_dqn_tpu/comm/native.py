@@ -19,24 +19,36 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(
 _SO = os.path.join(os.path.dirname(_SRC), "libapex_framing.so")
 
 
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
 def _load() -> ctypes.CDLL | None:
+    # module-level cache: the codec runs per ingest message; don't
+    # re-enter build_and_load's lock or rebind argtypes per call
+    global _lib, _tried
+    if _tried:
+        return _lib
     lib = build_and_load(_SRC, _SO)
     if lib is not None:
-        # idempotent; build_and_load caches the CDLL per process
-        lib.apex_crc32.restype = ctypes.c_uint32
-        lib.apex_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                   ctypes.c_uint32]
-        lib.apex_pack.restype = ctypes.c_uint64
-        lib.apex_pack.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_void_p),
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
-        lib.apex_unpack_offsets.restype = ctypes.c_uint64
-        lib.apex_unpack_offsets.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
-    return lib
+        try:
+            lib.apex_crc32.restype = ctypes.c_uint32
+            lib.apex_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_uint32]
+            lib.apex_pack.restype = ctypes.c_uint64
+            lib.apex_pack.argtypes = [
+                ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+            lib.apex_unpack_offsets.restype = ctypes.c_uint64
+            lib.apex_unpack_offsets.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
+        except AttributeError:
+            lib = None  # stale .so missing a symbol: Python fallback
+    _lib, _tried = lib, True
+    return _lib
 
 
 def have_native() -> bool:

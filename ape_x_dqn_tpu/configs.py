@@ -111,6 +111,11 @@ class ActorConfig:
     # this many times per actor slot; Ape-X tolerates actor loss, so a
     # restart costs only the crashed actor's in-flight transitions
     max_restarts: int = 2
+    # multihost: how long an actor-less listening learner waits for its
+    # first remote actor-host connection before it may report idle
+    # (raise for cluster queues / slow container pulls; too low and a
+    # learner-only fleet self-terminates with 0 grad steps)
+    remote_boot_grace_s: float = 300.0
     # continuous-control exploration noise stddev (DPG)
     noise_sigma: float = 0.2
 

@@ -29,17 +29,31 @@ _SO = os.path.join(os.path.dirname(_SRC),
                    f"libapex_preproc.{machine_tag()}.so")
 
 
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
 def _load() -> ctypes.CDLL | None:
+    # module-level cache: preproc() runs once per env step in every
+    # actor thread, so it must not re-enter build_and_load's global
+    # lock or rebind argtypes per frame (benign if two threads race
+    # the first call — the work is idempotent)
+    global _lib, _tried
+    if _tried:
+        return _lib
     lib = build_and_load(_SRC, _SO,
                          flags=("-march=native", "-ffp-contract=off"))
     if lib is not None:
-        # idempotent; build_and_load caches the CDLL per process
-        lib.apex_preproc.restype = None
-        lib.apex_preproc.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_uint64, ctypes.c_uint64,
-            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
-    return lib
+        try:
+            lib.apex_preproc.restype = None
+            lib.apex_preproc.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_uint64,
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+        except AttributeError:
+            lib = None  # stale .so missing the symbol: numpy fallback
+    _lib, _tried = lib, True
+    return _lib
 
 
 def available() -> bool:

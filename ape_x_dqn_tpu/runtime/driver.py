@@ -39,7 +39,8 @@ from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, family_setup, server_apply_fn,
     warmup_example)
 from ape_x_dqn_tpu.runtime.dpg_learner import DPGLearner
-from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
+from ape_x_dqn_tpu.runtime.evaluation import (
+    EvalWorker, make_eval_policy_factory)
 from ape_x_dqn_tpu.runtime.learner import DQNLearner
 from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 from ape_x_dqn_tpu.runtime.single_process import build_replay
@@ -264,34 +265,11 @@ class ApexDriver:
         """The batched forward the inference server jits (family.py)."""
         return server_apply_fn(self.family, self.net)
 
-    def _make_eval_policy(self):
-        """Per-episode policy factory for the eval worker: recurrent
-        policies carry fresh (c, h) across an episode's queries;
-        continuous policies return the deterministic action mu(s)."""
-        if self.family == "dpg":
-            query = self.server.query
-            return lambda: lambda obs: query(obs)["a"]
-        if self.family != "r2d2":
-            return None  # EvalWorker defaults to the plain query_fn
-        lstm_size = self.cfg.network.lstm_size
-        query = self.server.query
-
-        def factory():
-            state = {"c": np.zeros(lstm_size, np.float32),
-                     "h": np.zeros(lstm_size, np.float32)}
-
-            def policy(obs):
-                out = query({"obs": obs, "c": state["c"], "h": state["h"]})
-                state["c"], state["h"] = out["c"], out["h"]
-                return out["q"]
-
-            return policy
-
-        return factory
-
     def _make_eval_worker(self) -> EvalWorker:
+        factory = make_eval_policy_factory(
+            self.family, self.cfg.network.lstm_size, self.server.query)
         return EvalWorker(self.cfg, self.server.query,
-                          policy_factory=self._make_eval_policy())
+                          policy_factory=factory)
 
     def _on_episode(self, actor_index: int, info: dict) -> None:
         with self._lock:

@@ -114,10 +114,39 @@ class EvalWorker:
 ATARI57_GAMES: tuple[str, ...] = tuple(sorted(ATARI_HUMAN_RANDOM))
 
 
+def make_eval_policy_factory(family: str, lstm_size: int,
+                             query_fn: Callable) -> Callable | None:
+    """Per-episode eval policy builder per model family (shared by
+    ApexDriver's eval loop and the standalone suite runner).
+
+    Recurrent policies carry fresh (c, h) across one episode's queries;
+    continuous policies return the deterministic action mu(s); plain
+    Q-nets need no factory (EvalWorker queries directly).
+    """
+    if family == "dpg":
+        return lambda: lambda obs: query_fn(obs)["a"]
+    if family != "r2d2":
+        return None
+
+    def factory():
+        state = {"c": np.zeros(lstm_size, np.float32),
+                 "h": np.zeros(lstm_size, np.float32)}
+
+        def policy(obs):
+            out = query_fn({"obs": obs, "c": state["c"], "h": state["h"]})
+            state["c"], state["h"] = out["c"], out["h"]
+            return out["q"]
+
+        return policy
+
+    return factory
+
+
 def evaluate_suite(cfg: RunConfig, query_fn: Callable,
                    games: Iterable[str] | None = None,
                    episodes_per_game: int | None = None,
-                   max_frames: int = 108_000) -> dict:
+                   max_frames: int = 108_000,
+                   policy_factory: Callable | None = None) -> dict:
     """Per-game greedy scores -> median human-normalized score.
 
     The Atari-57 harness (SURVEY.md §2.1 config 3): loops the suite,
@@ -126,10 +155,14 @@ def evaluate_suite(cfg: RunConfig, query_fn: Callable,
     {game: hns}, "median_hns": float}.
     """
     games = tuple(games) if games is not None else ATARI57_GAMES
-    episodes = episodes_per_game or cfg.eval_episodes
+    # at least one episode: worker.run(0) returns None, and a suite
+    # score of None is useless (configs legitimately carry
+    # eval_episodes=0 to disable the TRAINING-time eval loop)
+    episodes = max(episodes_per_game or cfg.eval_episodes, 1)
     scores: dict[str, float] = {}
     for game in games:
-        worker = EvalWorker(cfg, query_fn, game=game)
+        worker = EvalWorker(cfg, query_fn, game=game,
+                            policy_factory=policy_factory)
         scores[game] = worker.run(episodes, max_frames)["mean_return"]
     known = {g: s for g, s in scores.items() if g in ATARI_HUMAN_RANDOM}
     from ape_x_dqn_tpu.utils.metrics import human_normalized_score
@@ -138,3 +171,64 @@ def evaluate_suite(cfg: RunConfig, query_fn: Callable,
         "hns": {g: human_normalized_score(g, s) for g, s in known.items()},
         "median_hns": median_hns(known),
     }
+
+
+def run_suite_eval(cfg: RunConfig, games: Iterable[str] | None = None,
+                   episodes_per_game: int | None = None,
+                   checkpoint_dir: str | None = None,
+                   max_frames: int = 108_000) -> dict:
+    """Standalone evaluation entry (CLI --eval-only): build the net,
+    restore the latest checkpoint's params, and run greedy episodes —
+    the full HNS suite for Atari configs, the config's own env
+    otherwise. No learner, no actors, no training state.
+    """
+    import jax
+
+    from ape_x_dqn_tpu.envs import make_env
+    from ape_x_dqn_tpu.models import build_network
+    from ape_x_dqn_tpu.runtime.family import (
+        family_of, family_setup, server_apply_fn)
+
+    family = family_of(cfg)
+    probe = make_env(cfg.env, seed=cfg.seed)
+    spec = probe.spec
+    net = build_network(cfg.network, spec)
+    params = family_setup(cfg, spec, net, probe.reset()).params
+    if family == "dpg":
+        params = {"actor": params[0], "critic": params[1]}
+    restored_step = None
+    if checkpoint_dir:
+        from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
+        mngr = CheckpointManager(checkpoint_dir)
+        restored_step = mngr.latest_step()
+        if restored_step is not None:
+            # raw restore (no template): we only need the param leaves,
+            # and the saved tree holds the full TrainState minus replay
+            raw = mngr.restore(restored_step)
+            if family == "dpg":
+                params = {"actor": raw["actor_params"],
+                          "critic": raw["critic_params"]}
+            else:
+                params = raw["params"]
+        mngr.close()
+
+    fn = jax.jit(server_apply_fn(family, net))
+
+    def query(inp):
+        batched = jax.tree.map(lambda x: np.asarray(x)[None], inp)
+        return jax.tree.map(lambda x: np.asarray(x)[0],
+                            fn(params, batched))
+
+    factory = make_eval_policy_factory(family, cfg.network.lstm_size,
+                                       query)
+    if games is None and cfg.env.kind not in ("atari", "synthetic_atari"):
+        worker = EvalWorker(cfg, query, policy_factory=factory)
+        out = worker.run(max(episodes_per_game or cfg.eval_episodes, 1),
+                         max_frames)
+    else:
+        out = evaluate_suite(cfg, query, games=games,
+                             episodes_per_game=episodes_per_game,
+                             max_frames=max_frames,
+                             policy_factory=factory)
+    out["restored_step"] = restored_step
+    return out

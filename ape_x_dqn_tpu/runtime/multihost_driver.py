@@ -339,15 +339,16 @@ class MultihostApexDriver:
             # read idle — at startup active_connections == 0 only
             # because producers are still booting, and an idle verdict
             # would terminate the fleet on round 1 with 0 grad steps.
-            # Bounded (5 min): an actor-host job that never launches
-            # must not pin the whole fleet in the round loop forever.
-            # The deadline is host-local wall clock, which is safe —
-            # it only changes this host's REPORTED flag, not the
-            # collective call sequence.
+            # Bounded (actors.remote_boot_grace_s): an actor-host job
+            # that never launches must not pin the whole fleet in the
+            # round loop forever. The deadline is host-local wall
+            # clock, which is safe — it only changes this host's
+            # REPORTED flag, not the collective call sequence.
             booting = (cfg.actors.num_actors == 0
                        and hasattr(self.transport, "active_connections")
                        and not self._saw_remote
-                       and time.monotonic() - t0 < 300.0)
+                       and time.monotonic() - t0
+                       < cfg.actors.remote_boot_grace_s)
             local_idle = 1.0 if (
                 not booting
                 and not any(t.is_alive() for t in threads)

@@ -101,6 +101,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "hot loop into this directory")
     ap.add_argument("--metrics-file", default=None,
                     help="JSONL metrics sink")
+    ap.add_argument("--eval-only", action="store_true",
+                    help="no training: restore the latest checkpoint and "
+                         "run greedy eval (the full HNS suite for Atari "
+                         "configs); prints one JSON line")
+    ap.add_argument("--games", default=None, metavar="G1,G2,...",
+                    help="with --eval-only: comma-separated ALE games "
+                         "(default: all 57)")
     ap.add_argument("--single-process", action="store_true",
                     help="config-1 style in-process loop (no threads)")
     ap.add_argument("--listen", default=None, metavar="HOST:PORT",
@@ -157,6 +164,18 @@ def main(argv: list[str] | None = None) -> int:
         # which the flag-level check above cannot see
         parser.error("checkpoint_dir is not supported in multihost "
                      "mode yet (set via --set or config preset)")
+
+    if args.eval_only:
+        if args.coordinator is not None:
+            parser.error("--eval-only is single-process (no learner "
+                         "mesh); drop --coordinator")
+        from ape_x_dqn_tpu.runtime.evaluation import run_suite_eval
+        out = run_suite_eval(
+            cfg, games=args.games.split(",") if args.games else None,
+            checkpoint_dir=args.checkpoint_dir or cfg.checkpoint_dir
+            or None)
+        print(json.dumps(out))
+        return 0
 
     metrics = Metrics(log_path=args.metrics_file)
     transport = server = None

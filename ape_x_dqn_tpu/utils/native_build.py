@@ -67,8 +67,13 @@ def machine_tag() -> str:
     """
     try:
         with open("/proc/cpuinfo") as fh:
+            # x86 keys plus their ARM equivalents; frequency lines vary
+            # run to run and must stay out of the hash
             lines = {ln for ln in fh
-                     if ln.startswith(("model name", "flags"))}
+                     if ln.startswith(("model name", "flags", "Features",
+                                       "CPU implementer", "CPU part"))}
+        if not lines:
+            raise OSError("no ISA-identifying cpuinfo lines")
         return hashlib.md5("".join(sorted(lines)).encode()).hexdigest()[:8]
     except OSError:
         return platform.machine() or "generic"

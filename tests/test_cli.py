@@ -83,3 +83,49 @@ def test_cli_driver_smoke(capsys):
     assert rc == 0, out
     assert out["grad_steps"] >= 30
     assert out["actor_errors"] == [] and out["loop_errors"] == []
+
+
+def test_cli_eval_only_restores_checkpoint(capsys, tmp_path):
+    """--eval-only: train briefly with checkpoints, then evaluate the
+    saved policy standalone (no learner/actors) through the same CLI.
+    Non-Atari configs evaluate their own env instead of the HNS suite."""
+    ckpt = str(tmp_path / "ckpt")
+    rc = main([
+        "--config", "cartpole_smoke",
+        "--total-env-frames", "900",
+        "--max-grad-steps", "30",
+        "--actors", "1",
+        "--checkpoint-dir", ckpt,
+        "--set", "replay.kind=prioritized",
+        "--set", "replay.capacity=2048",
+        "--set", "replay.min_fill=64",
+        "--set", "learner.batch_size=32",
+        "--set", "inference.max_batch=8",
+        "--set", "eval_every_steps=0",
+        "--set", "eval_episodes=0",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    rc = main([
+        "--config", "cartpole_smoke", "--eval-only",
+        "--checkpoint-dir", ckpt,
+        "--set", "eval_episodes=2",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["restored_step"] is not None and out["restored_step"] >= 30
+    assert out["episodes"] == 2 and out["mean_return"] > 0
+
+
+def test_cli_eval_only_suite_games(capsys):
+    """--eval-only --games on an Atari config runs the HNS harness over
+    the named games (synthetic env stands in for ALE here)."""
+    rc = main([
+        "--config", "pong", "--eval-only",
+        "--games", "pong,breakout",
+        "--set", "eval_episodes=1",
+    ])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert set(out["scores"]) == {"pong", "breakout"}
+    assert "median_hns" in out and out["restored_step"] is None
