@@ -98,7 +98,27 @@ def make_global(mesh: Mesh, local: Any) -> Any:
     return jax.tree.map(one, local)
 
 
-_reduce_jits: dict[Mesh, Any] = {}
+_reduce_jits: dict[Any, Any] = {}
+
+
+def global_min_scalar(mesh: Mesh, value: int) -> int:
+    """Min of each process's integer scalar — one-off agreement values
+    outside the hot loop (e.g. which checkpoint step every process can
+    restore; min handles a host whose filesystem lacks the files).
+
+    int32 lanes, NOT f32: checkpoint steps exceed f32's 2^24 exact
+    range within hours at the measured learner rate, and a rounded
+    step number would name a checkpoint that was never written.
+    Values must fit int32 (|v| < 2^31 — ~50 days of grad steps)."""
+    assert -(2**31) < value < 2**31, value
+    start, stop = process_rows(mesh)
+    block = np.full((stop - start, 1), value, np.int32)
+    arr = make_global(mesh, block)
+    fn = _reduce_jits.get((mesh, "min"))
+    if fn is None:
+        fn = jax.jit(jnp.min, out_shardings=NamedSharding(mesh, P()))
+        _reduce_jits[(mesh, "min")] = fn
+    return int(fn(arr))
 
 
 def global_stats(mesh: Mesh, ready: float, idle: float,

@@ -45,6 +45,7 @@ import time
 from collections import deque
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -61,6 +62,7 @@ from ape_x_dqn_tpu.runtime.driver import build_prioritized_replay
 from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, family_setup, server_apply_fn,
     warmup_example)
+from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
 from ape_x_dqn_tpu.utils.metrics import Metrics
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
@@ -157,12 +159,112 @@ class MultihostApexDriver:
         self.episode_returns: deque[float] = deque(maxlen=200)
         self._frames_local = 0
         self._grad_steps = 0
+        self._gather_jit = None
+        self._restored_step: int | None = None
+        # checkpoint/resume (SURVEY.md §5): the gather to host is a
+        # collective every process joins, and every process calls the
+        # (internally synchronized) orbax manager; the bytes land once
+        # via the primary process, so checkpoint_dir should be a SHARED
+        # filesystem for restore to reach every process (a host whose
+        # dir is empty makes the fleet agree on "no restore" rather
+        # than hang — see _maybe_restore)
+        self.ckpt = (CheckpointManager(cfg.checkpoint_dir)
+                     if cfg.checkpoint_dir else None)
+        if self.ckpt is not None:
+            self._maybe_restore()
         self._stage: list[dict] = []
         self._stage_n = 0
         self._actor_threads: list[threading.Thread] = []
         self._saw_remote = False  # first remote actor-host connection
         self._lock = threading.Lock()
         self.actor_errors: list[tuple[int, Exception]] = []
+
+    # -- checkpoint/resume -------------------------------------------------
+
+    def _ckpt_payload(self) -> dict:
+        """COLLECTIVE: TrainState minus replay, gathered to fully
+        replicated host numpy — every process must call this at the
+        same point; the result is identical everywhere. PRNG keys ride
+        as raw key data (numpy can't hold typed keys)."""
+        if self._gather_jit is None:
+            repl = NamedSharding(self.mesh, P())
+            self._gather_jit = jax.jit(
+                lambda p, t, o, r, s: (p, t, o, jax.random.key_data(r),
+                                       s),
+                out_shardings=repl)
+        s = self.state
+        p, t, o, r, step = self._gather_jit(
+            s.params, s.target_params, s.opt_state, s.rng, s.step)
+        return jax.tree.map(np.asarray, {
+            "params": p, "target_params": t, "opt_state": o,
+            "rng": r, "step": step})
+
+    def _save_checkpoint(self, wait: bool = False) -> None:
+        # EVERY process calls save: orbax's multiprocess manager
+        # synchronizes internally (barriers inside save/close), so a
+        # process-0-only call would deadlock the others; the payload is
+        # replicated host numpy, which orbax writes once from the
+        # primary process
+        payload = self._ckpt_payload()  # collective: all processes
+        self.ckpt.save(self._grad_steps, payload, wait=wait)
+
+    def _restore_leaf(self, x, ref):
+        """Host numpy -> global array with ref's sharding (the callback
+        hands each process the slices it owns; every process holds the
+        identical full host copy).
+
+        Only a NamedSharding on the global mesh is trusted: scalar jit
+        outputs (optimizer counters, step) can surface with a
+        SingleDeviceSharding, which names a DIFFERENT device on each
+        process — rebuilding with it would give every host its own
+        incompatible copy and the next collective jit rejects the
+        state. Those leaves restore replicated on the mesh instead."""
+        x = np.asarray(x)
+        sharding = (ref.sharding
+                    if isinstance(ref.sharding, NamedSharding)
+                    else NamedSharding(self.mesh, P()))
+        if jnp.issubdtype(ref.dtype, jax.dtypes.prng_key):
+            data = jax.make_array_from_callback(
+                x.shape, NamedSharding(self.mesh, P("dp")),
+                lambda idx: x[idx])
+            return jax.jit(jax.random.wrap_key_data,
+                           out_shardings=sharding)(data)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
+    def _maybe_restore(self) -> None:
+        """Restore the newest checkpoint step EVERY process can read.
+        The min-agreement makes a missing/stale directory on one host
+        degrade to a fresh start (or an older common step) instead of
+        deadlocking the collectives."""
+        local = self.ckpt.latest_step()
+        agreed = multihost.global_min_scalar(
+            self.mesh, -1 if local is None else int(local))
+        if agreed < 0:
+            return
+        # template restore (the fresh state's own payload): a raw
+        # restore would hand back plain dicts/lists where the live
+        # opt_state is an optax NamedTuple chain, and the re-shard
+        # tree.map would see mismatched structures
+        raw = self.ckpt.restore(agreed, template=self._ckpt_payload())
+        put = {
+            k: jax.tree.map(self._restore_leaf, v,
+                            getattr(self.state, k))
+            for k, v in raw.items() if k != "step"}
+        step = jax.make_array_from_callback(
+            (), NamedSharding(self.mesh, P()),
+            lambda idx: np.asarray(raw["step"], np.int32))
+        self.state = self.state._replace(step=step, **put)
+        self._grad_steps = int(raw["step"])
+        self._restored_step = agreed
+        # republish: the inference server and transport were seeded
+        # with the FRESH init params at construction; without this,
+        # resumed actors refill the empty replay with a random policy
+        # until the first publish_every boundary (the single-host
+        # _maybe_restore ends with _publish_params for the same reason)
+        pub = self._host_params()
+        self.server.update_params(pub, self._grad_steps)
+        self.transport.publish_params(pub, self._grad_steps)
 
     def _host_params(self):
         """publish_params (collective, all processes call) -> host numpy
@@ -316,6 +418,7 @@ class MultihostApexDriver:
         filled = 0
         frames_global = 0.0
         loss = float("nan")
+        last_ckpt = self._grad_steps
         global_size = jax.jit(
             lambda s: s.replay.size.sum(),
             out_shardings=jax.sharding.NamedSharding(
@@ -392,6 +495,14 @@ class MultihostApexDriver:
                         frames_local=frames_local,
                         avg_return=(float(np.mean(returns))
                                     if returns else None))
+            # checkpoint on a grad-step cadence: _grad_steps is a
+            # global value, so every process enters the collective
+            # payload gather on the same round
+            if (self.ckpt is not None
+                    and self._grad_steps - last_ckpt
+                    >= cfg.checkpoint_every):
+                self._save_checkpoint()
+                last_ckpt = self._grad_steps
             # 3. global termination — all conditions derive from the
             # round-start packed collective, so every process breaks on
             # the same round. Guards against frame counts that never
@@ -415,6 +526,14 @@ class MultihostApexDriver:
                 # (sleep is host-local pacing, no collective is skipped)
                 time.sleep(0.05)
 
+        # final checkpoint BEFORE joining actors: the break is lockstep
+        # (same round on every process), so the collective gather here
+        # is aligned; actor joins are host-local and may take unequal
+        # time
+        if self.ckpt is not None and self._grad_steps > last_ckpt:
+            self._save_checkpoint(wait=True)
+        if self.ckpt is not None:
+            self.ckpt.close()
         self.stop_event.set()
         for t in threads:
             t.join(timeout=5)
@@ -431,5 +550,6 @@ class MultihostApexDriver:
             "replay_filled": filled,
             "avg_return": avg_ret,
             "wall_s": time.monotonic() - t0,
+            "restored_step": self._restored_step,
             "actor_errors": [f"{i}: {e!r}" for i, e in self.actor_errors],
         }

@@ -132,13 +132,13 @@ def main(argv: list[str] | None = None) -> int:
         if args.num_processes is None or args.process_id is None:
             parser.error("--coordinator requires --num-processes and "
                          "--process-id")
-        if args.checkpoint_dir or args.wall_clock_limit is not None:
+        if args.wall_clock_limit is not None:
             # wall clocks differ across hosts (they would diverge the
-            # lockstep call sequences) and checkpointing is not wired
-            # into the multihost round loop yet — reject rather than
-            # silently ignore
-            parser.error("--checkpoint-dir / --wall-clock-limit are not "
-                         "supported in multihost mode yet")
+            # lockstep call sequences) — reject rather than silently
+            # ignore
+            parser.error("--wall-clock-limit is not supported in "
+                         "multihost mode (host clocks differ; use "
+                         "--max-grad-steps / --total-env-frames)")
         if args.single_process:
             parser.error("--single-process and --coordinator conflict")
         # must happen before any JAX backend use
@@ -158,12 +158,6 @@ def main(argv: list[str] | None = None) -> int:
     if args.profile_dir is not None:
         cfg = cfg.replace(profile_dir=args.profile_dir)
     cfg = apply_overrides(cfg, args.set)
-
-    if args.coordinator is not None and cfg.checkpoint_dir:
-        # catches checkpoint_dir arriving via --set or a preset default,
-        # which the flag-level check above cannot see
-        parser.error("checkpoint_dir is not supported in multihost "
-                     "mode yet (set via --set or config preset)")
 
     if args.eval_only:
         if args.coordinator is not None:
