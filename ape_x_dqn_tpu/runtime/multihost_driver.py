@@ -168,6 +168,21 @@ class MultihostApexDriver:
         # filesystem for restore to reach every process (a host whose
         # dir is empty makes the fleet agree on "no restore" rather
         # than hang — see _maybe_restore)
+        # all-or-none agreement BEFORE the orbax manager exists: its
+        # CONSTRUCTOR already runs multiprocess collectives, so a fleet
+        # where only some processes got --checkpoint-dir would issue
+        # mismatched collective programs (orbax allgather on some
+        # hosts, this min on others) and die in a Gloo timeout with an
+        # inscrutable error; every process can see the disagreement
+        # here and error loudly instead
+        has = 1 if cfg.checkpoint_dir else 0
+        mn = multihost.global_min_scalar(self.mesh, has)
+        mx = -multihost.global_min_scalar(self.mesh, -has)
+        if mn != mx:
+            raise ValueError(
+                "checkpoint_dir must be set on EVERY process or none "
+                f"(this process: {'set' if has else 'unset'}) — "
+                "checkpoint save/restore are collectives")
         self.ckpt = (CheckpointManager(cfg.checkpoint_dir)
                      if cfg.checkpoint_dir else None)
         if self.ckpt is not None:
@@ -423,108 +438,119 @@ class MultihostApexDriver:
             lambda s: s.replay.size.sum(),
             out_shardings=jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec()))
-        while True:
-            self._pump_ingest()
-            progressed = False
-            # 0. ONE packed collective for this round's global control
-            # values (three separate reductions would pay three
-            # sequential DCN barrier round-trips per round).
-            # `local_idle`: this host can never produce another ingest
-            # block — actors finished/dead, no live remote actor-host
-            # connections, transport drained. Deliberately independent
-            # of the stage: a host stranded with a full block that OTHER
-            # hosts can never match must still read as idle, or an
-            # asymmetric drain spins every process forever.
-            blocks_ready = 1.0 if self._stage_n >= \
-                self.dp_local * self._chunk else 0.0
-            # boot grace: a host with NO local actors whose listening
-            # transport has never seen a remote actor-host must not
-            # read idle — at startup active_connections == 0 only
-            # because producers are still booting, and an idle verdict
-            # would terminate the fleet on round 1 with 0 grad steps.
-            # Bounded (actors.remote_boot_grace_s): an actor-host job
-            # that never launches must not pin the whole fleet in the
-            # round loop forever. The deadline is host-local wall
-            # clock, which is safe — it only changes this host's
-            # REPORTED flag, not the collective call sequence.
-            booting = (cfg.actors.num_actors == 0
-                       and hasattr(self.transport, "active_connections")
-                       and not self._saw_remote
-                       and time.monotonic() - t0
-                       < cfg.actors.remote_boot_grace_s)
-            local_idle = 1.0 if (
-                not booting
-                and not any(t.is_alive() for t in threads)
-                and getattr(self.transport, "active_connections", 0) == 0
-                and self.transport.pending == 0) else 0.0
-            with self._lock:
-                frames_local = self._frames_local
-            all_ready, all_idle, frames_global = multihost.global_stats(
-                self.mesh, blocks_ready, local_idle, float(frames_local))
-            # 1. collective ingest, gated on EVERY host having a block
-            if all_ready:
-                block = self._pop_block()
-                items = multihost.make_global(
-                    self.mesh,
-                    {k: v for k, v in block.items() if k != "priorities"})
-                pris = multihost.make_global(self.mesh,
-                                             block["priorities"])
-                self.state = self.learner.add(self.state, items, pris)
-                filled = int(global_size(self.state))
-                progressed = True
-            # 2. lockstep training, branch on global values only
-            if filled >= self._min_fill() \
-                    and self._grad_steps < max_grad_steps:
-                to_publish = publish_every - (self._grad_steps
-                                              % publish_every)
-                k = chunk_steps if chunk_steps <= min(
-                    max_grad_steps - self._grad_steps, to_publish) else 1
-                self.state, m = self.learner.train_many(self.state, k)
-                self._grad_steps += k
-                loss = float(m["loss"])
-                progressed = True
-                if self._grad_steps % publish_every == 0:
-                    pub = self._host_params()
-                    self.server.update_params(pub, self._grad_steps)
-                    self.transport.publish_params(pub, self._grad_steps)
-                    with self._lock:
-                        returns = list(self.episode_returns)
-                    self.metrics.log(
-                        self._grad_steps, loss=loss, replay_filled=filled,
-                        frames_global=int(frames_global),
-                        frames_local=frames_local,
-                        avg_return=(float(np.mean(returns))
-                                    if returns else None))
-            # checkpoint on a grad-step cadence: _grad_steps is a
-            # global value, so every process enters the collective
-            # payload gather on the same round
-            if (self.ckpt is not None
-                    and self._grad_steps - last_ckpt
-                    >= cfg.checkpoint_every):
-                self._save_checkpoint()
-                last_ckpt = self._grad_steps
-            # 3. global termination — all conditions derive from the
-            # round-start packed collective, so every process breaks on
-            # the same round. Guards against frame counts that never
-            # reach `total` (lossy-transport drops, per-actor truncation
-            # of the budget).
-            if self._grad_steps >= max_grad_steps:
-                break
-            if frames_global >= total and max_grad_steps >= 10**9:
-                break  # frame-budget run: actors are done
-            if all_idle and not all_ready and (max_grad_steps >= 10**9
-                                               or filled
-                                               < self._min_fill()):
-                # no host can ever produce experience again and the
-                # ingest gate cannot fire (stranded partial blocks can
-                # never complete); either there is no finite step target
-                # to chase, or training can never start — spinning
-                # helps nobody
-                break
-            if not progressed:
-                # idle round: don't hammer the coordination service
-                # (sleep is host-local pacing, no collective is skipped)
-                time.sleep(0.05)
+        try:
+            while True:
+                self._pump_ingest()
+                progressed = False
+                # 0. ONE packed collective for this round's global control
+                # values (three separate reductions would pay three
+                # sequential DCN barrier round-trips per round).
+                # `local_idle`: this host can never produce another ingest
+                # block — actors finished/dead, no live remote actor-host
+                # connections, transport drained. Deliberately independent
+                # of the stage: a host stranded with a full block that OTHER
+                # hosts can never match must still read as idle, or an
+                # asymmetric drain spins every process forever.
+                blocks_ready = 1.0 if self._stage_n >= \
+                    self.dp_local * self._chunk else 0.0
+                # boot grace: a host with NO local actors whose listening
+                # transport has never seen a remote actor-host must not
+                # read idle — at startup active_connections == 0 only
+                # because producers are still booting, and an idle verdict
+                # would terminate the fleet on round 1 with 0 grad steps.
+                # Bounded (actors.remote_boot_grace_s): an actor-host job
+                # that never launches must not pin the whole fleet in the
+                # round loop forever. The deadline is host-local wall
+                # clock, which is safe — it only changes this host's
+                # REPORTED flag, not the collective call sequence.
+                booting = (cfg.actors.num_actors == 0
+                           and hasattr(self.transport, "active_connections")
+                           and not self._saw_remote
+                           and time.monotonic() - t0
+                           < cfg.actors.remote_boot_grace_s)
+                local_idle = 1.0 if (
+                    not booting
+                    and not any(t.is_alive() for t in threads)
+                    and getattr(self.transport, "active_connections", 0) == 0
+                    and self.transport.pending == 0) else 0.0
+                with self._lock:
+                    frames_local = self._frames_local
+                all_ready, all_idle, frames_global = multihost.global_stats(
+                    self.mesh, blocks_ready, local_idle, float(frames_local))
+                # 1. collective ingest, gated on EVERY host having a block
+                if all_ready:
+                    block = self._pop_block()
+                    items = multihost.make_global(
+                        self.mesh,
+                        {k: v for k, v in block.items() if k != "priorities"})
+                    pris = multihost.make_global(self.mesh,
+                                                 block["priorities"])
+                    self.state = self.learner.add(self.state, items, pris)
+                    filled = int(global_size(self.state))
+                    progressed = True
+                # 2. lockstep training, branch on global values only
+                if filled >= self._min_fill() \
+                        and self._grad_steps < max_grad_steps:
+                    to_publish = publish_every - (self._grad_steps
+                                                  % publish_every)
+                    k = chunk_steps if chunk_steps <= min(
+                        max_grad_steps - self._grad_steps, to_publish) else 1
+                    self.state, m = self.learner.train_many(self.state, k)
+                    self._grad_steps += k
+                    loss = float(m["loss"])
+                    progressed = True
+                    if self._grad_steps % publish_every == 0:
+                        pub = self._host_params()
+                        self.server.update_params(pub, self._grad_steps)
+                        self.transport.publish_params(pub, self._grad_steps)
+                        with self._lock:
+                            returns = list(self.episode_returns)
+                        self.metrics.log(
+                            self._grad_steps, loss=loss, replay_filled=filled,
+                            frames_global=int(frames_global),
+                            frames_local=frames_local,
+                            avg_return=(float(np.mean(returns))
+                                        if returns else None))
+                # checkpoint on a grad-step cadence: _grad_steps is a
+                # global value, so every process enters the collective
+                # payload gather on the same round
+                if (self.ckpt is not None
+                        and self._grad_steps - last_ckpt
+                        >= cfg.checkpoint_every):
+                    self._save_checkpoint()
+                    last_ckpt = self._grad_steps
+                # 3. global termination — all conditions derive from the
+                # round-start packed collective, so every process breaks on
+                # the same round. Guards against frame counts that never
+                # reach `total` (lossy-transport drops, per-actor truncation
+                # of the budget).
+                if self._grad_steps >= max_grad_steps:
+                    break
+                if frames_global >= total and max_grad_steps >= 10**9:
+                    break  # frame-budget run: actors are done
+                if all_idle and not all_ready and (max_grad_steps >= 10**9
+                                                   or filled
+                                                   < self._min_fill()):
+                    # no host can ever produce experience again and the
+                    # ingest gate cannot fire (stranded partial blocks can
+                    # never complete); either there is no finite step target
+                    # to chase, or training can never start — spinning
+                    # helps nobody
+                    break
+                if not progressed:
+                    # idle round: don't hammer the coordination service
+                    # (sleep is host-local pacing, no collective is skipped)
+                    time.sleep(0.05)
+        except BaseException:
+            # crash path: HOST-LOCAL teardown only. The clean-exit
+            # sequence below runs collectives (final checkpoint gather,
+            # orbax's synchronized close) that would hang on peers that
+            # diverged or died with us; signal local actors/server and
+            # let the exception surface (threads are daemon — process
+            # exit is not blocked).
+            self.stop_event.set()
+            self.server.stop()
+            raise
 
         # final checkpoint BEFORE joining actors: the break is lockstep
         # (same round on every process), so the collective gather here

@@ -242,3 +242,50 @@ def test_apex_driver_shuts_down_when_learner_cannot_progress():
     assert out["actor_errors"] == [], out["actor_errors"]
     assert out["grad_steps"] == 0
     assert out["wall_s"] < 50  # returned well before the wall-clock limit
+
+
+def test_learner_fixed_seed_bitwise_deterministic():
+    """SURVEY.md §4 determinism: identical seed + identical ingest ->
+    bitwise-identical params after N fused train steps on CPU (the
+    whole sample->loss->opt->priority->sync cycle is one jit with its
+    RNG threaded through the state, so there is no hidden entropy)."""
+    import jax
+
+    from ape_x_dqn_tpu.envs.base import EnvSpec
+    from ape_x_dqn_tpu.models import build_network
+    from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+    from ape_x_dqn_tpu.runtime.learner import (DQNLearner,
+                                               transition_item_spec)
+    from ape_x_dqn_tpu.utils.rng import component_key
+
+    spec = EnvSpec(obs_shape=(4,), obs_dtype=np.dtype(np.float32),
+                   discrete=True, num_actions=2)
+    rng = np.random.default_rng(7)
+    n = 256
+    items = {
+        "obs": rng.standard_normal((n, 4)).astype(np.float32),
+        "action": rng.integers(0, 2, n).astype(np.int32),
+        "reward": rng.standard_normal(n).astype(np.float32),
+        "next_obs": rng.standard_normal((n, 4)).astype(np.float32),
+        "discount": np.full(n, 0.97, np.float32),
+    }
+    pris = rng.random(n).astype(np.float32) + 0.1
+
+    def run_once():
+        net = build_network(
+            NetworkConfig(kind="mlp", mlp_hidden=(32,)), spec)
+        params = net.init(component_key(3, "net"),
+                          np.zeros((1, 4), np.float32))
+        learner = DQNLearner(net.apply, PrioritizedReplay(capacity=512),
+                             LearnerConfig(batch_size=32))
+        state = learner.init(
+            params,
+            learner.replay.init(transition_item_spec(spec.obs_shape,
+                                                     spec.obs_dtype)),
+            component_key(3, "learner"))
+        state = learner.add(state, items, pris)
+        state, _ = learner.train_many(state, 50)
+        return jax.tree.map(np.asarray, state.params)
+
+    a, b = run_once(), run_once()
+    jax.tree.map(np.testing.assert_array_equal, a, b)
