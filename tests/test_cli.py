@@ -2,6 +2,8 @@
 
 import json
 
+import numpy as np
+
 import pytest
 
 from ape_x_dqn_tpu.configs import get_config
@@ -129,3 +131,57 @@ def test_cli_eval_only_suite_games(capsys):
     assert rc == 0
     assert set(out["scores"]) == {"pong", "breakout"}
     assert "median_hns" in out and out["restored_step"] is None
+
+
+def test_cli_eval_only_r2d2_restores_checkpoint(capsys, tmp_path):
+    """--eval-only on the recurrent family: restore an R2D2 checkpoint
+    and run the stateful {obs,c,h} eval policy standalone."""
+    ckpt = str(tmp_path / "ckpt")
+    common = [
+        "--config", "r2d2",
+        "--set", "env.id=CartPolePO", "--set", "env.kind=cartpole_po",
+        "--set", "network.lstm_size=16", "--set", "network.torso_dense=32",
+        "--set", "network.compute_dtype=float32",
+        "--set", "replay.capacity=256", "--set", "replay.seq_length=8",
+        "--set", "replay.seq_overlap=4", "--set", "replay.burn_in=2",
+        "--set", "replay.min_fill=8", "--set", "replay.storage=flat",
+        "--set", "learner.batch_size=8",
+        "--set", "parallel.dp=1", "--set", "parallel.tp=1",
+        "--set", "actors.num_actors=1",
+        "--set", "eval_every_steps=0", "--set", "eval_episodes=0",
+    ]
+    rc = main(common + ["--total-env-frames", "600",
+                        "--max-grad-steps", "10",
+                        "--checkpoint-dir", ckpt])
+    capsys.readouterr()
+    assert rc == 0
+    rc = main(common + ["--eval-only", "--checkpoint-dir", ckpt,
+                        "--set", "eval_episodes=1"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["restored_step"] is not None
+    assert out["episodes"] == 1 and out["mean_return"] > 0
+
+
+def test_cli_eval_only_dpg_restores_checkpoint(capsys, tmp_path):
+    """--eval-only on the continuous family: actor/critic params map
+    from the DPG checkpoint into the deterministic mu(s) eval policy."""
+    ckpt = str(tmp_path / "ckpt")
+    common = [
+        "--config", "apex_dpg",
+        "--set", "replay.capacity=512", "--set", "replay.min_fill=64",
+        "--set", "learner.batch_size=16",
+        "--set", "actors.num_actors=1",
+        "--set", "eval_every_steps=0", "--set", "eval_episodes=0",
+    ]
+    rc = main(common + ["--total-env-frames", "600",
+                        "--max-grad-steps", "10",
+                        "--checkpoint-dir", ckpt])
+    capsys.readouterr()
+    assert rc == 0
+    rc = main(common + ["--eval-only", "--checkpoint-dir", ckpt,
+                        "--set", "eval_episodes=1"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["restored_step"] is not None
+    assert out["episodes"] == 1 and np.isfinite(out["mean_return"])
