@@ -29,6 +29,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -119,7 +120,7 @@ class SocketIngestServer:
     """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 max_pending: int = 64):
+                 max_pending: int = 64, idle_grace_s: float = 5.0):
         self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
         self._dropped = 0
         self._params: tuple[Any, int] = (None, -1)
@@ -132,7 +133,15 @@ class SocketIngestServer:
         self._listener.listen(128)
         self._listener.settimeout(0.2)
         self.port = self._listener.getsockname()[1]
+        # _conns is mutated by the accept thread and every reader thread
+        # and read by the driver's idle/termination check — the check is
+        # load-bearing for fleet lifetime (a stale read can terminate a
+        # multihost run early), so mutations take an explicit lock
+        # rather than leaning on the GIL's list-op atomicity
         self._conns: list[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self._idle_grace_s = idle_grace_s
+        self._last_disconnect: float | None = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ingest-accept", daemon=True)
         self._accept_thread.start()
@@ -193,12 +202,31 @@ class SocketIngestServer:
         """Live remote actor-host connections (readers deregister on
         disconnect). Drivers use this for idle/termination checks — a
         drained queue does not mean producers are done."""
-        return len(self._conns)
+        with self._conns_lock:
+            return len(self._conns)
+
+    def quiesced(self) -> bool:
+        """True when no remote producer is connected AND none has
+        disconnected within the last idle_grace_s. The grace period
+        debounces transient drops: SocketTransport reconnects a broken
+        send inside the same call, so an actor host that blipped is
+        back within milliseconds — an idle verdict taken in that window
+        would terminate a multihost fleet whose producers all intend to
+        return (round-2 advisor finding on local_idle)."""
+        with self._conns_lock:
+            if self._conns:
+                return False
+            if self._last_disconnect is None:
+                return True
+            return (time.monotonic() - self._last_disconnect
+                    >= self._idle_grace_s)
 
     def stop(self) -> None:
         self._stop.set()
         self._accept_thread.join(timeout=2)
-        for c in list(self._conns):
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
             try:
                 c.close()
             except OSError:
@@ -216,7 +244,8 @@ class SocketIngestServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
+            with self._conns_lock:
+                self._conns.append(conn)
             threading.Thread(target=self._reader, args=(conn,),
                              name="ingest-reader", daemon=True).start()
 
@@ -234,10 +263,12 @@ class SocketIngestServer:
         except (OSError, ValueError):
             return  # dead/corrupt connection: drop it, keep serving others
         finally:
-            try:
-                self._conns.remove(conn)  # actor churn must not leak socks
-            except ValueError:
-                pass
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)  # churn must not leak socks
+                except ValueError:
+                    pass
+                self._last_disconnect = time.monotonic()
             try:
                 conn.close()
             except OSError:

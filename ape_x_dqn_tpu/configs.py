@@ -101,6 +101,13 @@ class LearnerConfig:
 @dataclass(frozen=True)
 class ActorConfig:
     num_actors: int = 8
+    # Envs per actor thread: >1 switches the dqn/dpg families to the
+    # vectorized actor (runtime/vector_actor.py) — one thread steps K
+    # envs and makes ONE batched inference query per vector step, so
+    # RPC round-trips amortize K ways and the server sees batch-K work
+    # (SURVEY.md §2.4 "inference batching parallelism", §7 hard part 3).
+    # The eps schedule spans num_actors * envs_per_actor global slots.
+    envs_per_actor: int = 1
     # eps_i = base_eps ** (1 + alpha * i / (N-1))  (Horgan et al. 2018)
     base_eps: float = 0.4
     eps_alpha: float = 7.0
@@ -188,8 +195,14 @@ def _preset_pong() -> RunConfig:
         network=NetworkConfig(kind="nature_cnn", dueling=True),
         replay=ReplayConfig(kind="prioritized", capacity=1_000_000,
                             min_fill=20_000, storage="frame_ring"),
-        learner=LearnerConfig(batch_size=512),
-        actors=ActorConfig(num_actors=8),
+        # steps_per_frame_cap pins the Ape-X effective replay ratio
+        # (Horgan et al. 2018: ~19 grad-steps/s at batch 512 against
+        # ~12.5k ingested transitions/s = ~0.78 samples/insert, i.e.
+        # ~1.6e-3 grad-steps per ingested env step). Without it the
+        # 490/s TPU learner free-runs hundreds of epochs over a slow
+        # actor fleet's replay — the pathology PERF.md measured live.
+        learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3),
+        actors=ActorConfig(num_actors=8, envs_per_actor=16),
     )
 
 
@@ -204,8 +217,12 @@ def _preset_atari57_apex() -> RunConfig:
         # fits in HBM as single frames (~10KB/transition vs ~56KB flat)
         replay=ReplayConfig(kind="prioritized", capacity=2_000_000,
                             storage="frame_ring"),
-        learner=LearnerConfig(batch_size=512),
-        actors=ActorConfig(num_actors=256),
+        # replay-ratio pin + vector actors: see the pong preset note.
+        # 256 actor threads x 16 envs = 4096 env slots across the
+        # remote actor hosts; each thread ships one 16-item inference
+        # query per vector step (runtime/vector_actor.py)
+        learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3),
+        actors=ActorConfig(num_actors=256, envs_per_actor=16),
         parallel=ParallelConfig(dp=4, tp=2),
     )
 

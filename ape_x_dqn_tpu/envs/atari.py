@@ -314,10 +314,20 @@ class AtariPreprocessing(Env):
         return obs, reward, done, info
 
 
+def atari_backend(kind: str) -> str:
+    """Which raw backend `make_atari` builds for an EnvConfig kind:
+    "ale" only when the real Arcade Learning Environment is importable
+    AND the config asks for real Atari; otherwise "synthetic" (the
+    in-image catch stand-in). Eval results must carry this marker so a
+    synthetic score can never masquerade as the north-star median-HNS
+    (runtime/evaluation.py)."""
+    return "ale" if (HAVE_ALE and kind == "atari") else "synthetic"
+
+
 def make_atari(cfg, seed: int = 0, actor_index: int = 0) -> Env:
     """Build the full preprocessed Atari env from an EnvConfig."""
     game = cfg.id
-    if HAVE_ALE and cfg.kind == "atari":  # pragma: no cover - needs ale_py
+    if atari_backend(cfg.kind) == "ale":  # pragma: no cover - needs ale_py
         raw: RawAtariEnv = ALERawEnv(_gym_id_to_ale(game), seed=seed)
     else:
         raw = SyntheticAtari(seed=seed * 9973 + actor_index)

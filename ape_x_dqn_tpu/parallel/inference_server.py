@@ -39,12 +39,20 @@ from ape_x_dqn_tpu.utils.misc import next_pow2
 
 
 class _Request:
-    __slots__ = ("inputs", "event", "result")
+    __slots__ = ("inputs", "n", "event", "result")
 
-    def __init__(self, inputs: Any):
+    def __init__(self, inputs: Any, n: int = 0):
+        """n == 0: single item, no batch dim on any leaf.
+        n >= 1: a multi-item request whose leaves carry a leading [n]
+        batch dim (vector actors ship one request per vector step)."""
         self.inputs = inputs
+        self.n = n
         self.event = threading.Event()
         self.result: Any = None
+
+    @property
+    def items(self) -> int:
+        return self.n if self.n else 1
 
 
 class BatchedInferenceServer:
@@ -81,6 +89,9 @@ class BatchedInferenceServer:
         self._max_batch = max_batch
         self._deadline_s = deadline_ms / 1000.0
         self._q: queue.Queue[_Request] = queue.Queue()
+        # a popped-but-not-admitted request (would overflow max_batch)
+        # held for the next batch — only the serve thread touches it
+        self._held: _Request | None = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._batches_served = 0
@@ -101,7 +112,23 @@ class BatchedInferenceServer:
             raise req.result
         return req.result
 
-    def warmup(self, example_input: Any) -> None:
+    def query_batch(self, inputs: Any, n: int, timeout: float = 30.0) -> Any:
+        """Blocking multi-item query: every leaf of `inputs` carries a
+        leading [n] batch dim; the reply's leaves do too. One request
+        per vector-actor step — K env observations ride one queue entry
+        and one scatter instead of K (SURVEY.md §2.4 "inference batching
+        parallelism")."""
+        assert n >= 1
+        req = _Request(inputs, n)
+        self._q.put(req)
+        if not req.event.wait(timeout):
+            raise TimeoutError("inference server did not reply")
+        if isinstance(req.result, Exception):
+            raise req.result
+        return req.result
+
+    def warmup(self, example_input: Any,
+               extra_sizes: tuple[int, ...] = ()) -> None:
         """AOT-compile the batched forward at bucket sizes 1 and
         max_batch before actors start querying. On TPU the first compile
         takes 10-40s — longer than a reasonable query timeout — so an
@@ -111,10 +138,28 @@ class BatchedInferenceServer:
         on first use, inside the 30s query timeout.
 
         example_input: one request pytree WITHOUT the batch dim (content
-        irrelevant; only shapes/dtypes feed the compile cache)."""
+        irrelevant; only shapes/dtypes feed the compile cache).
+        extra_sizes: additional request sizes to pre-bucket (drivers pass
+        envs_per_actor; a vector request larger than max_batch serves
+        alone in its own bucket, which must therefore be warm too)."""
         with self._lock:
             params = self._params
-        for b in sorted({self._bucket(1), self._bucket(self._max_batch)}):
+        # every bucket a pow2 REQUEST size up to max_batch can land in:
+        # coalesced batches hit any of them (e.g. 2-3 K-item vector
+        # requests -> bucket 2K/4K, truncation flushes -> small
+        # buckets), and a cold intermediate bucket under load stalls
+        # every queued actor behind one compile. Mapping _bucket over
+        # request sizes (not doubling _bucket(1)) matters when the mesh
+        # size is not a power of two: buckets are pow2 rounded up to a
+        # mesh-size multiple, which doubling would skip.
+        sizes = set()
+        n = 1
+        while n < self._max_batch:
+            sizes.add(self._bucket(n))
+            n *= 2
+        sizes.add(self._bucket(self._max_batch))
+        sizes.update(self._bucket(s) for s in extra_sizes if s >= 1)
+        for b in sorted(sizes):
             stacked = jax.tree.map(
                 lambda x: np.zeros((b, *np.asarray(x).shape),
                                    np.asarray(x).dtype), example_input)
@@ -148,20 +193,37 @@ class BatchedInferenceServer:
     # -- server loop -------------------------------------------------------
 
     def _collect(self) -> list[_Request]:
-        try:
-            first = self._q.get(timeout=0.05)
-        except queue.Empty:
-            return []
+        if self._held is not None:
+            first, self._held = self._held, None
+        else:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                return []
         reqs = [first]
+        items = first.items
         deadline = time.monotonic() + self._deadline_s
-        while len(reqs) < self._max_batch:
+        # max_batch counts ITEMS, not requests: a vector actor's K-item
+        # request fills K slots of the batch budget. A request that
+        # would overflow the budget is HELD for the next batch (never
+        # split) — otherwise a coalesced batch could exceed max_batch
+        # and land in a bucket warmup never compiled (a 10-40s TPU
+        # stall that times out every waiting actor). A single oversized
+        # request still serves alone: its own bucket was warmed via
+        # warmup's extra_sizes.
+        while items < self._max_batch:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
             try:
-                reqs.append(self._q.get(timeout=remaining))
+                r = self._q.get(timeout=remaining)
             except queue.Empty:
                 break
+            if items + r.items > self._max_batch:
+                self._held = r
+                break
+            reqs.append(r)
+            items += r.items
         return reqs
 
     def _serve_loop(self) -> None:
@@ -185,25 +247,37 @@ class BatchedInferenceServer:
         return b
 
     def _serve_batch(self, reqs: list[_Request]) -> None:
-        n = len(reqs)
+        n = sum(r.items for r in reqs)
         padded = self._bucket(n)
-        stacked = jax.tree.map(
-            lambda *xs: _pad_stack(xs, padded), *[r.inputs for r in reqs])
+        # every request's leaves get a leading batch dim (single-item
+        # requests gain one), then requests concatenate into one batch
+        leads = [r.inputs if r.n else
+                 jax.tree.map(lambda x: np.asarray(x)[None], r.inputs)
+                 for r in reqs]
+        stacked = jax.tree.map(lambda *xs: _pad_concat(xs, padded), *leads)
         if self._batched_sharding is not None:
             stacked = jax.device_put(stacked, self._batched_sharding)
         with self._lock:
             params = self._params
         out = self._apply(params, stacked)
         out_np = jax.tree.map(np.asarray, out)
-        for i, r in enumerate(reqs):
-            r.result = jax.tree.map(lambda x: x[i], out_np)
+        off = 0
+        for r in reqs:
+            if r.n:
+                lo, hi = off, off + r.n
+                r.result = jax.tree.map(lambda x: x[lo:hi], out_np)
+            else:
+                idx = off
+                r.result = jax.tree.map(lambda x: x[idx], out_np)
+            off += r.items
             r.event.set()
         self._batches_served += 1
         self._items_served += n
 
 
-def _pad_stack(xs: tuple, padded: int) -> np.ndarray:
-    arr = np.stack([np.asarray(x) for x in xs])
+def _pad_concat(xs: tuple, padded: int) -> np.ndarray:
+    arr = (np.asarray(xs[0]) if len(xs) == 1
+           else np.concatenate([np.asarray(x) for x in xs]))
     if arr.shape[0] < padded:
         pad_width = [(0, padded - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
         arr = np.pad(arr, pad_width)

@@ -133,21 +133,22 @@ def global_stats(mesh: Mesh, ready: float, idle: float,
     every round) that returns both the row-min (flags) and the row-sum
     (frame limbs).
 
-    Exactness: f32 rounds integers above 2^24, and frame counts reach
-    billions at atari57 scale — a rounded-down global count would stall
-    the frame-budget termination forever. The per-process count
-    therefore rides as three base-2^16 limbs on ONE row per process
-    (zeros on its other rows, so limb sums scale with process count,
-    not dp): each limb < 2^16, so limb-sums stay exact through 256
-    processes and counts to 2^48, and the limbs recombine exactly in
-    Python ints. Flags tile across all the process's rows (min is
-    idempotent over copies).
+    Exactness: frame counts reach billions at atari57 scale — a
+    rounded-down global count would stall the frame-budget termination
+    forever. The lanes are int32 (like global_min_scalar; f32 rounds
+    integers above 2^24, which a 256-process fleet's limb sums would
+    already exceed): the per-process count rides as three base-2^16
+    limbs on ONE row per process (zeros on its other rows, so limb sums
+    scale with process count, not dp). Each limb < 2^16, so int32
+    limb-sums stay exact through 2^15 processes and counts to 2^48, and
+    the limbs recombine exactly in Python ints. Flags tile across all
+    the process's rows (min is idempotent over copies).
     """
     v = int(frames)
-    flags = [ready, idle]
+    flags = [int(ready), int(idle)]
     limbs = [(v >> 32) & 0xFFFF, (v >> 16) & 0xFFFF, v & 0xFFFF]
     start, stop = process_rows(mesh)
-    block = np.zeros((stop - start, 5), np.float32)
+    block = np.zeros((stop - start, 5), np.int32)
     block[:, :2] = flags
     block[0, 2:] = limbs
     arr = make_global(mesh, block)
@@ -159,6 +160,6 @@ def global_stats(mesh: Mesh, ready: float, idle: float,
         _reduce_jits[mesh] = fn
     mins, sums = fn(arr)
     mins, sums = np.asarray(mins), np.asarray(sums)
-    l2, l1, l0 = (int(round(s)) for s in sums[2:])
+    l2, l1, l0 = (int(s) for s in sums[2:])
     total = float((l2 << 32) + (l1 << 16) + l0)
-    return bool(mins[0] >= 1.0), bool(mins[1] >= 1.0), total
+    return bool(mins[0] >= 1), bool(mins[1] >= 1), total

@@ -37,14 +37,84 @@ def actor_epsilon(i: int, n: int, base: float = 0.4,
     return base ** (1.0 + alpha * i / (n - 1))
 
 
-class Actor:
-    """Discrete eps_i-greedy actor; also the base for ContinuousActor.
+def flat_transition_batch(ts: list[NStepTransition], pris: np.ndarray,
+                          actions: np.ndarray, actor_index: int,
+                          frames: int) -> dict:
+    """The wire format for a batch of flat n-step transitions — one
+    schema for the scalar and vector actors (the ingest staging and
+    transition_item_spec depend on these exact keys)."""
+    return {
+        "obs": np.stack([t.obs for t in ts]),
+        "action": actions,
+        "reward": np.asarray([t.reward for t in ts], np.float32),
+        "next_obs": np.stack([t.next_obs for t in ts]),
+        "discount": np.asarray([t.discount for t in ts], np.float32),
+        "priorities": pris,
+        "actor": actor_index,
+        "frames": frames,
+    }
 
-    Subclass hooks: `_select_action` (policy out -> action),
-    `_bootstrap_value` (policy out -> V(s) estimate for n-step targets),
-    `_taken_value` (policy out + action -> the value whose TD error seeds
-    the initial priority), `_action_array` (stacking dtype for shipment).
-    """
+
+class DiscretePolicyHooks:
+    """Eps-greedy Q-policy hooks shared by the scalar and vector
+    discrete actors. Host class provides self.spec and self.rng.
+
+    Hooks: `_select_action` (policy out + eps -> action),
+    `_bootstrap_value` (policy out -> V(s) estimate for n-step
+    targets), `_taken_value` (policy out + action -> the value whose TD
+    error seeds the initial priority), `_action_array` (stacking dtype
+    for shipment)."""
+
+    def _select_action(self, out, eps: float):
+        if self.rng.random() < eps:
+            return int(self.rng.integers(self.spec.num_actions))
+        return int(np.argmax(out))
+
+    def _bootstrap_value(self, out) -> float:
+        return float(np.max(out))
+
+    def _taken_value(self, out, action) -> float:
+        return float(out[action])
+
+    def _action_array(self, ts: list[NStepTransition]) -> np.ndarray:
+        return np.asarray([t.action for t in ts], np.int32)
+
+
+class ContinuousPolicyHooks:
+    """Ape-X DPG policy hooks shared by the scalar and vector actors:
+    deterministic mu(s) + Gaussian exploration noise (Horgan et al.
+    2018 "Ape-X DPG"), with initial priorities seeded from the critic's
+    Q(s, mu(s)). Host class provides self.spec, self.rng, and calls
+    _init_noise(cfg) after self.spec exists."""
+
+    def _init_noise(self, cfg: RunConfig) -> None:
+        self._noise_scale = (cfg.actors.noise_sigma
+                             * (self.spec.action_high
+                                - self.spec.action_low) / 2.0)
+
+    def _select_action(self, out, eps: float):
+        # eps is unused: continuous exploration is additive noise
+        noise = self.rng.normal(0.0, self._noise_scale,
+                                size=self.spec.action_dim)
+        return np.clip(np.asarray(out["a"], np.float32) + noise,
+                       self.spec.action_low,
+                       self.spec.action_high).astype(np.float32)
+
+    def _bootstrap_value(self, out) -> float:
+        return float(out["q"])
+
+    def _taken_value(self, out, action) -> float:
+        # Q(s, mu(s)) stands in for Q(s, a_taken): the noise
+        # perturbation is small, and this only seeds initial priority
+        return float(out["q"])
+
+    def _action_array(self, ts: list[NStepTransition]) -> np.ndarray:
+        return np.stack([np.asarray(t.action, np.float32) for t in ts])
+
+
+class Actor(DiscretePolicyHooks):
+    """Discrete eps_i-greedy actor; also the base for ContinuousActor
+    (which overrides the policy hooks via ContinuousPolicyHooks)."""
 
     _ships_frame_segments = True  # flat family only (see __init__)
 
@@ -62,6 +132,7 @@ class Actor:
         seed = cfg.seed if seed is None else seed
         self.env = make_env(cfg.env, seed=seed * 10_007 + actor_index,
                             actor_index=actor_index)
+        self.spec = self.env.spec
         self.rng = np.random.default_rng(seed * 7919 + actor_index)
         self.nstep = NStepBuilder(cfg.learner.n_step, cfg.learner.gamma)
         self.episode_callback = episode_callback
@@ -82,22 +153,6 @@ class Actor:
             self._seg = FrameSegmentBuilder(
                 cfg.replay.seg_transitions, cfg.learner.n_step,
                 stack=spec.obs_shape[-1])
-
-    # -- policy hooks (overridden by ContinuousActor) ----------------------
-
-    def _select_action(self, out):
-        if self.rng.random() < self.eps:
-            return int(self.rng.integers(self.env.spec.num_actions))
-        return int(np.argmax(out))
-
-    def _bootstrap_value(self, out) -> float:
-        return float(np.max(out))
-
-    def _taken_value(self, out, action) -> float:
-        return float(out[action])
-
-    def _action_array(self, ts: list[NStepTransition]) -> np.ndarray:
-        return np.asarray([t.action for t in ts], np.int32)
 
     # -- priority resolution ----------------------------------------------
 
@@ -153,16 +208,8 @@ class Actor:
             return
         ts = [t for t, _ in self._outbox]
         pris = np.asarray([p for _, p in self._outbox], np.float32)
-        batch = {
-            "obs": np.stack([t.obs for t in ts]),
-            "action": self._action_array(ts),
-            "reward": np.asarray([t.reward for t in ts], np.float32),
-            "next_obs": np.stack([t.next_obs for t in ts]),
-            "discount": np.asarray([t.discount for t in ts], np.float32),
-            "priorities": pris,
-            "actor": self.index,
-            "frames": self._frames_unshipped,
-        }
+        batch = flat_transition_batch(ts, pris, self._action_array(ts),
+                                      self.index, self._frames_unshipped)
         self._outbox = []
         self._frames_unshipped = 0
         self.transport.send_experience(batch)
@@ -178,7 +225,7 @@ class Actor:
                 stop_event is not None and stop_event.is_set()):
             out = self.query(obs)
             self._resolve_pending(out)
-            action = self._select_action(out)
+            action = self._select_action(out, self.eps)
             next_obs, reward, done, info = self.env.step(action)
             self.frames += 1
             self._frames_unshipped += 1
@@ -213,7 +260,7 @@ class Actor:
         return self.frames
 
 
-class ContinuousActor(Actor):
+class ContinuousActor(ContinuousPolicyHooks, Actor):
     """Ape-X DPG actor: deterministic policy + Gaussian exploration noise.
 
     Horgan et al. 2018 "Ape-X DPG" (SURVEY.md §2.1 config 5): actions are
@@ -222,7 +269,8 @@ class ContinuousActor(Actor):
     server evaluates both the policy and the critic in one batched
     forward — {"a": mu(s), "q": Q(s, mu(s))} — so actors compute initial
     priorities from the critic's value estimates exactly like discrete
-    actors do from max-Q (same one-step pending mechanism).
+    actors do from max-Q (same one-step pending mechanism). Policy hooks
+    live in ContinuousPolicyHooks (shared with ContinuousVectorActor).
     """
 
     _ships_frame_segments = False  # DPG obs are low-dimensional
@@ -233,29 +281,7 @@ class ContinuousActor(Actor):
                  episode_callback: Callable[[int, dict], None] | None = None):
         super().__init__(cfg, actor_index, query_fn, transport, seed=seed,
                          episode_callback=episode_callback)
-        self.sigma = cfg.actors.noise_sigma
-        spec = self.env.spec
-        self._noise_scale = (self.sigma
-                             * (spec.action_high - spec.action_low) / 2.0)
-
-    def _select_action(self, out):
-        spec = self.env.spec
-        noise = self.rng.normal(0.0, self._noise_scale,
-                                size=spec.action_dim)
-        return np.clip(np.asarray(out["a"], np.float32) + noise,
-                       spec.action_low,
-                       spec.action_high).astype(np.float32)
-
-    def _bootstrap_value(self, out) -> float:
-        return float(out["q"])
-
-    def _taken_value(self, out, action) -> float:
-        # Q(s, mu(s)) stands in for Q(s, a_taken): the noise perturbation
-        # is small, and this is only the initial-priority seed
-        return float(out["q"])
-
-    def _action_array(self, ts: list[NStepTransition]) -> np.ndarray:
-        return np.stack([np.asarray(t.action, np.float32) for t in ts])
+        self._init_noise(cfg)
 
 
 class RecurrentActor(Actor):

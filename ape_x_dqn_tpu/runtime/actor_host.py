@@ -68,7 +68,8 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         deadline_ms=cfg.inference.deadline_ms)
     server.update_params(params, version)
     try:  # pre-compile the forward so first queries don't time out
-        server.warmup(warmup_example(family, cfg, probe.spec))
+        server.warmup(warmup_example(family, cfg, probe.spec),
+                      extra_sizes=(cfg.actors.envs_per_actor,))
     except (AttributeError, NotImplementedError):
         # AOT lowering unavailable on this backend: compile lazily on
         # first query. Anything else (shape mismatch, compile OOM) is a
@@ -91,10 +92,14 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     errors: list[tuple[int, Exception]] = []
     frames = [0] * n
 
+    vector = cfg.actors.envs_per_actor > 1
+    cls = actor_class(family, vector=vector)
+    query = server.query_batch if vector else server.query
+
     def actor_thread(slot: int) -> None:
         idx = actor_offset + slot
         try:
-            actor = actor_class(family)(cfg, idx, server.query, transport)
+            actor = cls(cfg, idx, query, transport)
             frames[slot] = actor.run(per_actor, stop_event)
         except Exception as e:  # noqa: BLE001 - reported to caller
             errors.append((idx, e))

@@ -84,6 +84,8 @@ class ApexDriver:
         # owns params init + replay item layout + staging geometry
         # (shared with the multihost driver).
         self.family = family_of(cfg)
+        if cfg.actors.envs_per_actor > 1:
+            actor_class(self.family, vector=True)  # fail fast: r2d2 raises
         setup = family_setup(cfg, self.spec, self.net, obs0)
         params, item_spec = setup.params, setup.item_spec
         self._frame_mode = setup.frame_mode
@@ -283,7 +285,9 @@ class ApexDriver:
         producers; losing one's in-flight transitions is harmless).
         Exhausting the budget records the error, which fails the run
         report (actor_errors)."""
-        actor_cls = actor_class(self.family)
+        vector = self.cfg.actors.envs_per_actor > 1
+        actor_cls = actor_class(self.family, vector=vector)
+        query = self.server.query_batch if vector else self.server.query
         remaining = max_frames
         restarts_left = self.cfg.actors.max_restarts
         attempt = 0
@@ -296,7 +300,7 @@ class ApexDriver:
                 # trajectory-dependent crash until the budget burns out
                 seed = (self.cfg.seed if attempt == 0
                         else self.cfg.seed + 7907 * attempt)
-                actor = actor_cls(self.cfg, i, self.server.query,
+                actor = actor_cls(self.cfg, i, query,
                                   self.transport, seed=seed,
                                   episode_callback=self._on_episode)
                 actor.run(remaining, self.stop_event)
@@ -460,8 +464,11 @@ class ApexDriver:
         if chunk > 1:
             cls.train_many.lower(learner, self.state, chunk).compile()
         # the inference server's first forward compile otherwise exceeds
-        # the actor query timeout on TPU (observed live)
-        self.server.warmup(warmup_example(self.family, self.cfg, self.spec))
+        # the actor query timeout on TPU (observed live); vector actors
+        # hit the envs_per_actor bucket on their very first query
+        self.server.warmup(
+            warmup_example(self.family, self.cfg, self.spec),
+            extra_sizes=(self.cfg.actors.envs_per_actor,))
 
     def _learner_loop(self, max_grad_steps: int) -> None:
         try:

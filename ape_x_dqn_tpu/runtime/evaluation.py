@@ -151,14 +151,25 @@ def evaluate_suite(cfg: RunConfig, query_fn: Callable,
 
     The Atari-57 harness (SURVEY.md §2.1 config 3): loops the suite,
     evaluates each game with the shared query_fn, and aggregates the
-    north-star `median_hns`. Returns {"scores": {game: mean}, "hns":
-    {game: hns}, "median_hns": float}.
+    north-star metric. Returns {"scores": {game: mean}, "hns":
+    {game: hns}, "backends": {game: "ale"|"synthetic"}, and EITHER
+    "median_hns" (every game ran on the real ALE) OR
+    "median_hns_synthetic" (any game ran the in-image catch stand-in).
+
+    The split key is deliberate: in an image without `ale_py`, make_env
+    silently substitutes SyntheticAtari for every game, and an unmarked
+    "median_hns" from that path would look exactly like the north-star
+    number while measuring a catch game. The real key only ever appears
+    when the real backend produced it.
     """
+    from ape_x_dqn_tpu.envs.atari import atari_backend
+
     games = tuple(games) if games is not None else ATARI57_GAMES
     # at least one episode: worker.run(0) returns None, and a suite
     # score of None is useless (configs legitimately carry
     # eval_episodes=0 to disable the TRAINING-time eval loop)
     episodes = max(episodes_per_game or cfg.eval_episodes, 1)
+    backend = atari_backend(cfg.env.kind)
     scores: dict[str, float] = {}
     for game in games:
         worker = EvalWorker(cfg, query_fn, game=game,
@@ -166,11 +177,14 @@ def evaluate_suite(cfg: RunConfig, query_fn: Callable,
         scores[game] = worker.run(episodes, max_frames)["mean_return"]
     known = {g: s for g, s in scores.items() if g in ATARI_HUMAN_RANDOM}
     from ape_x_dqn_tpu.utils.metrics import human_normalized_score
-    return {
+    out = {
         "scores": scores,
         "hns": {g: human_normalized_score(g, s) for g, s in known.items()},
-        "median_hns": median_hns(known),
+        "backends": {g: backend for g in scores},
     }
+    key = "median_hns" if backend == "ale" else "median_hns_synthetic"
+    out[key] = median_hns(known)
+    return out
 
 
 def run_suite_eval(cfg: RunConfig, games: Iterable[str] | None = None,
@@ -189,6 +203,15 @@ def run_suite_eval(cfg: RunConfig, games: Iterable[str] | None = None,
     from ape_x_dqn_tpu.runtime.family import (
         family_of, family_setup, server_apply_fn)
 
+    if games is not None and cfg.env.kind not in ("atari",
+                                                  "synthetic_atari"):
+        # an explicit --games list builds per-game Atari envs, whose
+        # 84x84x4 observations cannot feed a network sized for this
+        # config's own env — fail with a clear message instead of an
+        # opaque downstream shape mismatch
+        raise ValueError(
+            f"--games is only valid for Atari configs (env.kind 'atari' "
+            f"or 'synthetic_atari'), got kind={cfg.env.kind!r}")
     family = family_of(cfg)
     probe = make_env(cfg.env, seed=cfg.seed)
     spec = probe.spec

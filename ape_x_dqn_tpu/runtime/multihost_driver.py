@@ -93,6 +93,8 @@ class MultihostApexDriver:
                 "single-process with remote actor hosts "
                 "(runtime/actor_host.py)")
         self.metrics = metrics or Metrics()
+        if cfg.actors.envs_per_actor > 1:
+            actor_class(self.family, vector=True)  # fail fast: r2d2 raises
         probe_env = make_env(cfg.env, seed=cfg.seed)
         self.spec = probe_env.spec
         self.net = build_network(cfg.network, self.spec)
@@ -111,11 +113,16 @@ class MultihostApexDriver:
         self._chunk = setup.stage_chunk
         self._item_keys = tuple(item_spec.keys())
         self._item_spec = item_spec
-        assert cfg.replay.kind in ("prioritized", "sequence"), \
-            "the multihost learner requires prioritized replay (the " \
-            "per-shard sum-trees ARE the sharded state; kind='sequence' " \
-            "for R2D2); got " \
-            f"replay.kind={cfg.replay.kind!r}"
+        if cfg.replay.kind not in ("prioritized", "sequence"):
+            # ValueError, not assert: user-config validation must
+            # survive `python -O` (neighboring checkpoint_dir check
+            # raises too) — an invalid kind would otherwise surface as
+            # an opaque failure inside the dist learner
+            raise ValueError(
+                "the multihost learner requires prioritized replay "
+                "(the per-shard sum-trees ARE the sharded state; "
+                "kind='sequence' for R2D2); got "
+                f"replay.kind={cfg.replay.kind!r}")
 
         # identical construction on every process (same cfg.seed) ->
         # identical initial params; learner.init then shards them over
@@ -158,6 +165,14 @@ class MultihostApexDriver:
         self.stop_event = threading.Event()
         self.episode_returns: deque[float] = deque(maxlen=200)
         self._frames_local = 0
+        # frame counters survive resume: _frames_base restores from the
+        # checkpoint so a --total-env-frames budget CONTINUES after a
+        # preemption instead of re-running in full (round-2 advisor
+        # finding); _frames_global_latest mirrors the last packed
+        # collective's total (identical on every process) for the
+        # checkpoint payload
+        self._frames_base = 0
+        self._frames_global_latest = 0
         self._grad_steps = 0
         self._gather_jit = None
         self._restored_step: int | None = None
@@ -210,9 +225,15 @@ class MultihostApexDriver:
         s = self.state
         p, t, o, r, step = self._gather_jit(
             s.params, s.target_params, s.opt_state, s.rng, s.step)
-        return jax.tree.map(np.asarray, {
+        out = jax.tree.map(np.asarray, {
             "params": p, "target_params": t, "opt_state": o,
             "rng": r, "step": step})
+        # host scalar, identical everywhere (it is the last packed
+        # collective's output): lets a frame-budget run resume its
+        # budget instead of restarting it
+        out["frames_global"] = np.asarray(self._frames_global_latest,
+                                          np.int64)
+        return out
 
     def _save_checkpoint(self, wait: bool = False) -> None:
         # EVERY process calls save: orbax's multiprocess manager
@@ -265,12 +286,15 @@ class MultihostApexDriver:
         put = {
             k: jax.tree.map(self._restore_leaf, v,
                             getattr(self.state, k))
-            for k, v in raw.items() if k != "step"}
+            for k, v in raw.items()
+            if k not in ("step", "frames_global")}
         step = jax.make_array_from_callback(
             (), NamedSharding(self.mesh, P()),
             lambda idx: np.asarray(raw["step"], np.int32))
         self.state = self.state._replace(step=step, **put)
         self._grad_steps = int(raw["step"])
+        self._frames_base = int(raw.get("frames_global", 0))
+        self._frames_global_latest = self._frames_base
         self._restored_step = agreed
         # republish: the inference server and transport were seeded
         # with the FRESH init params at construction; without this,
@@ -313,9 +337,15 @@ class MultihostApexDriver:
                 self.cfg, actors=dataclasses.replace(
                     self.cfg.actors,
                     num_actors=n_local * jax.process_count()))
-            actor = actor_class(self.family)(
+            # vector actors (envs_per_actor > 1) compute their per-env
+            # eps slots from acfg's global num_actors, so the schedule
+            # spans the whole nproc * num_actors * K fleet
+            vector = self.cfg.actors.envs_per_actor > 1
+            query = (self.server.query_batch if vector
+                     else self.server.query)
+            actor = actor_class(self.family, vector=vector)(
                 acfg, jax.process_index() * n_local + i,
-                self.server.query, self.transport,
+                query, self.transport,
                 episode_callback=self._on_episode)
             actor.run(max_frames, self.stop_event)
         except Exception as e:  # noqa: BLE001 - reported in run() output
@@ -425,13 +455,21 @@ class MultihostApexDriver:
             # compile lazily. Anything else is a real bug that must
             # surface, not a degraded start (mirrors ApexDriver.run).
             self.metrics.log(0, warmup_skipped=repr(e))
-        self.server.warmup(warmup_example(self.family, cfg, self.spec))
+        try:
+            self.server.warmup(
+                warmup_example(self.family, cfg, self.spec),
+                extra_sizes=(cfg.actors.envs_per_actor,))
+        except (AttributeError, NotImplementedError) as e:
+            # same degradation as the learner warmup above and the
+            # actor_host path: no AOT lowering -> lazy first-query
+            # compiles (anything else must surface)
+            self.metrics.log(0, server_warmup_skipped=repr(e))
         for t in threads:
             t.start()
 
         t0 = time.monotonic()
         filled = 0
-        frames_global = 0.0
+        frames_global = float(self._frames_base)
         loss = float("nan")
         last_ckpt = self._grad_steps
         global_size = jax.jit(
@@ -468,15 +506,29 @@ class MultihostApexDriver:
                            and not self._saw_remote
                            and time.monotonic() - t0
                            < cfg.actors.remote_boot_grace_s)
+                # quiesced() (socket transport) debounces transient
+                # remote disconnects with a grace window; transports
+                # without it (loopback) fall back to the connection
+                # count, which for them never flickers
+                remote_quiet = (
+                    self.transport.quiesced()
+                    if hasattr(self.transport, "quiesced")
+                    else getattr(self.transport,
+                                 "active_connections", 0) == 0)
                 local_idle = 1.0 if (
                     not booting
                     and not any(t.is_alive() for t in threads)
-                    and getattr(self.transport, "active_connections", 0) == 0
+                    and remote_quiet
                     and self.transport.pending == 0) else 0.0
                 with self._lock:
                     frames_local = self._frames_local
                 all_ready, all_idle, frames_global = multihost.global_stats(
                     self.mesh, blocks_ready, local_idle, float(frames_local))
+                # resumed runs continue their frame budget from the
+                # checkpointed global count (per-round counts restart
+                # at 0 after a restore)
+                frames_global += self._frames_base
+                self._frames_global_latest = int(frames_global)
                 # 1. collective ingest, gated on EVERY host having a block
                 if all_ready:
                     block = self._pop_block()
@@ -488,8 +540,15 @@ class MultihostApexDriver:
                     self.state = self.learner.add(self.state, items, pris)
                     filled = int(global_size(self.state))
                     progressed = True
-                # 2. lockstep training, branch on global values only
-                if filled >= self._min_fill() \
+                # 2. lockstep training, branch on global values only.
+                # steps_per_frame_cap paces the learner to the GLOBAL
+                # ingested frame count (frames_global comes from the
+                # packed collective, so every process skips the same
+                # rounds — the pacing itself is lockstep-safe)
+                cap = cfg.learner.steps_per_frame_cap
+                cap_bound = (cap is not None
+                             and self._grad_steps >= cap * frames_global)
+                if filled >= self._min_fill() and not cap_bound \
                         and self._grad_steps < max_grad_steps:
                     to_publish = publish_every - (self._grad_steps
                                                   % publish_every)
@@ -530,12 +589,14 @@ class MultihostApexDriver:
                     break  # frame-budget run: actors are done
                 if all_idle and not all_ready and (max_grad_steps >= 10**9
                                                    or filled
-                                                   < self._min_fill()):
+                                                   < self._min_fill()
+                                                   or cap_bound):
                     # no host can ever produce experience again and the
                     # ingest gate cannot fire (stranded partial blocks can
                     # never complete); either there is no finite step target
-                    # to chase, or training can never start — spinning
-                    # helps nobody
+                    # to chase, training can never start, or the frame-
+                    # pacing cap binds forever (frames_global is final) —
+                    # spinning helps nobody
                     break
                 if not progressed:
                     # idle round: don't hammer the coordination service

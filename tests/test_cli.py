@@ -130,7 +130,9 @@ def test_cli_eval_only_suite_games(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0
     assert set(out["scores"]) == {"pong", "breakout"}
-    assert "median_hns" in out and out["restored_step"] is None
+    # synthetic stand-in ran: the north-star key must be namespaced
+    assert "median_hns_synthetic" in out and "median_hns" not in out
+    assert out["restored_step"] is None
 
 
 def test_cli_eval_only_r2d2_restores_checkpoint(capsys, tmp_path):

@@ -88,6 +88,64 @@ def test_socket_transport_experience_and_params():
         server.stop()
 
 
+def test_conn_tracking_under_connect_disconnect_hammer():
+    """_conns is mutated by the accept + reader threads while the
+    multihost idle check reads it (round-2 verdict weak #6): hammer
+    connect/disconnect cycles against concurrent active_connections /
+    quiesced readers and assert the count settles to exactly zero with
+    the debounce behaving."""
+    import socket as socketlib
+    import threading
+    import time
+
+    server = SocketIngestServer("127.0.0.1", 0, idle_grace_s=1.0)
+    stop = threading.Event()
+    snapshots: list[int] = []
+
+    def reader():
+        while not stop.is_set():
+            n = server.active_connections
+            assert n >= 0
+            snapshots.append(n)
+            server.quiesced()  # must never raise mid-churn
+
+    rthreads = [threading.Thread(target=reader, daemon=True)
+                for _ in range(2)]
+    for t in rthreads:
+        t.start()
+    try:
+        saw_open = False
+        for it in range(30):
+            socks = [socketlib.create_connection(("127.0.0.1", server.port),
+                                                 timeout=5)
+                     for _ in range(4)]
+            if not saw_open:
+                # observe a live count at least once while socks are open
+                # (the accept thread needs a moment on a 1-core host)
+                deadline = time.monotonic() + 5
+                while (server.active_connections == 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+                saw_open = server.active_connections > 0
+            for s in socks:
+                s.close()
+        assert saw_open, "accept loop never registered a connection"
+        deadline = time.monotonic() + 5
+        while server.active_connections and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert server.active_connections == 0
+        assert snapshots, "concurrent readers never ran"
+        # a disconnect just happened: the idle verdict must debounce
+        assert not server.quiesced()
+        time.sleep(1.1)
+        assert server.quiesced()
+    finally:
+        stop.set()
+        for t in rthreads:
+            t.join(timeout=2)
+        server.stop()
+
+
 def test_socket_client_survives_dead_server():
     """Ingest is lossy-tolerant: a broken connection must not raise into
     the actor loop — batches count as dropped."""

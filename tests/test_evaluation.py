@@ -55,7 +55,39 @@ def test_evaluate_suite_median_hns():
     assert set(out["scores"]) == {"pong", "breakout"}
     assert set(out["hns"]) == {"pong", "breakout"}
     expect = median_hns({g: out["scores"][g] for g in out["scores"]})
-    assert abs(out["median_hns"] - expect) < 1e-9
+    assert abs(out["median_hns_synthetic"] - expect) < 1e-9
+
+
+def test_synthetic_suite_never_emits_unmarked_north_star():
+    """Round-2 verdict weak #2: in an image without ale_py, every game
+    silently runs the synthetic stand-in — the result must mark every
+    game's backend and must NOT carry the north-star 'median_hns' key
+    (it appears only when the real ALE produced it)."""
+    cfg = get_config("pong").replace(
+        env=EnvConfig(id="pong", kind="atari"),  # asks for REAL atari
+        eval_episodes=1)
+
+    def query_fn(obs):
+        return np.zeros(6, np.float32)
+
+    out = evaluate_suite(cfg, query_fn, games=("pong",),
+                         episodes_per_game=1, max_frames=300)
+    assert out["backends"] == {"pong": "synthetic"}
+    assert "median_hns" not in out
+    assert "median_hns_synthetic" in out
+
+
+def test_suite_eval_rejects_games_for_non_atari_config():
+    """--games on a non-Atari config would build per-game Atari envs
+    against a network sized for the config's own env; it must fail with
+    a clear error instead (round-2 advisor finding)."""
+    import pytest
+
+    from ape_x_dqn_tpu.runtime.evaluation import run_suite_eval
+
+    cfg = get_config("cartpole_smoke")
+    with pytest.raises(ValueError, match="only valid for Atari"):
+        run_suite_eval(cfg, games=("pong",))
 
 
 def test_atari57_suite_is_57_games():

@@ -26,7 +26,10 @@ _SETS = [
     "learner.batch_size=32", "learner.n_step=3",
     "learner.target_sync_every=100", "learner.publish_every=10",
     "learner.train_chunk=2",
+    # envs_per_actor=2 routes the multihost local-actor path through
+    # the vectorized actor (one query_batch per vector step)
     "actors.num_actors=1", "actors.base_eps=0.6", "actors.ingest_batch=8",
+    "actors.envs_per_actor=2",
     "inference.max_batch=8", "inference.deadline_ms=1.0",
     "eval_every_steps=0", "eval_episodes=0",
 ]
@@ -66,6 +69,27 @@ def test_frame_budget_terminates_when_total_unreachable():
     # per-actor truncation: 1001 // 2 procs // 3 actors = 166 each
     assert outs[0]["frames"] == outs[1]["frames"] <= 996
     assert outs[0]["frames"] > 0
+
+
+def test_multihost_steps_per_frame_cap_binds():
+    """learner.steps_per_frame_cap must pace the lockstep learner to
+    the GLOBAL frame count (and the fleet must still terminate when the
+    cap binds forever after actors finish)."""
+    cap = 0.05
+    port = _free_port()
+    procs = [_launch(port, pid,
+                     ["--total-env-frames", "800",
+                      "--set", f"learner.steps_per_frame_cap={cap}"])
+             for pid in range(2)]
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=300)
+        assert p.returncode == 0, stderr[-3000:]
+        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+    assert outs[0]["grad_steps"] == outs[1]["grad_steps"]
+    assert outs[0]["grad_steps"] > 0, outs
+    # pacing rechecks before each <= train_chunk dispatch
+    assert outs[0]["grad_steps"] <= cap * outs[0]["frames"] + 2, outs
 
 
 def test_two_process_lockstep_training(tmp_path):

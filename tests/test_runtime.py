@@ -244,6 +244,36 @@ def test_apex_driver_shuts_down_when_learner_cannot_progress():
     assert out["wall_s"] < 50  # returned well before the wall-clock limit
 
 
+def test_steps_per_frame_cap_binds_when_actors_stall():
+    """Round-2 verdict weak #5: with steps_per_frame_cap set, the
+    learner must pace itself to the ingested frame count instead of
+    free-running on replay once actors stop producing."""
+    cap = 0.05
+    cfg = _tiny_cfg(num_actors=1).replace(
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20,
+                              train_chunk=4, steps_per_frame_cap=cap),
+        eval_every_steps=0, eval_episodes=0)
+    driver = ApexDriver(cfg)
+    out = driver.run(total_env_frames=1200, max_grad_steps=10**9,
+                     wall_clock_limit_s=120)
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert out["loop_errors"] == [], out["loop_errors"]
+    assert out["grad_steps"] > 0, "cap starved the learner entirely"
+    # the pacing check runs before each dispatch of <= train_chunk
+    # steps, so the cap can overshoot by at most one chunk
+    assert out["grad_steps"] <= cap * out["frames"] + cfg.learner.train_chunk, out
+
+
+def test_flagship_presets_pin_replay_ratio():
+    """The pong/atari57 presets carry the Ape-X effective replay ratio
+    (~1.6e-3 grad-steps per ingested env step) and vector actors."""
+    for name in ("pong", "atari57_apex"):
+        cfg = get_config(name)
+        assert cfg.learner.steps_per_frame_cap == pytest.approx(1.6e-3), name
+        assert cfg.actors.envs_per_actor > 1, name
+
+
 def test_learner_fixed_seed_bitwise_deterministic():
     """SURVEY.md §4 determinism: identical seed + identical ingest ->
     bitwise-identical params after N fused train steps on CPU (the
