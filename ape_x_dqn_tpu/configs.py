@@ -160,6 +160,19 @@ class RunConfig:
     eval_eps: float = 0.001
     checkpoint_dir: str = ""
     checkpoint_every: int = 50_000
+    # Opt-in, SINGLE-HOST driver only (the multihost driver rejects it:
+    # its replicated payload gather would multiply the save by dp x
+    # capacity): include the device ReplayState (storage + sum-tree +
+    # cursors) in checkpoints. Resume then skips the min_fill refill
+    # stall and keeps the replay distribution continuous across a
+    # preemption (SURVEY.md §5 "and (optionally) replay contents").
+    # The flag governs SAVES; restores follow what the checkpoint
+    # contains, so toggling it between runs cannot brick resume.
+    # Cost scales with capacity — the flagship's 2M-transition
+    # frame-ring is ~20GB per save plus a transient on-device copy, so
+    # the default stays off; at Pong-scale capacities it is cheap
+    # (measured: see PERF.md "Replay-contents checkpointing").
+    checkpoint_replay: bool = False
     # JAX profiler capture (SURVEY.md §5 tracing/profiling): when set,
     # the driver traces `profile_steps` learner grad-steps starting at
     # the first dispatch after min-fill into this directory

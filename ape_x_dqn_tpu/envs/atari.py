@@ -145,7 +145,7 @@ class SyntheticAtari(RawAtariEnv):
         return frame
 
 
-class ALERawEnv(RawAtariEnv):  # pragma: no cover - needs ale_py
+class ALERawEnv(RawAtariEnv):
     """Real Arcade Learning Environment behind the raw interface."""
 
     def __init__(self, game: str, seed: int = 0, repeat_action_prob=0.25):
@@ -327,7 +327,7 @@ def atari_backend(kind: str) -> str:
 def make_atari(cfg, seed: int = 0, actor_index: int = 0) -> Env:
     """Build the full preprocessed Atari env from an EnvConfig."""
     game = cfg.id
-    if atari_backend(cfg.kind) == "ale":  # pragma: no cover - needs ale_py
+    if atari_backend(cfg.kind) == "ale":
         raw: RawAtariEnv = ALERawEnv(_gym_id_to_ale(game), seed=seed)
     else:
         raw = SyntheticAtari(seed=seed * 9973 + actor_index)

@@ -82,7 +82,7 @@ class PendulumSwingUp(Env):
         return self._obs(), reward, done, info
 
 
-class DMControlAdapter(Env):  # pragma: no cover - needs dm_control
+class DMControlAdapter(Env):
     """dm_control.suite task behind the Env API (flattened observations)."""
 
     def __init__(self, domain: str, task: str, seed: int = 0):
@@ -117,7 +117,7 @@ class DMControlAdapter(Env):  # pragma: no cover - needs dm_control
 
 
 def make_control(cfg, seed: int = 0) -> Env:
-    if HAVE_DM_CONTROL and "_" in cfg.id:  # pragma: no cover
+    if HAVE_DM_CONTROL and "_" in cfg.id:
         domain, task = cfg.id.split("_", 1)
         return DMControlAdapter(domain, task, seed=seed)
     return PendulumSwingUp(seed=seed)

@@ -137,10 +137,19 @@ class _DistLearnerBase:
 
         items, idx, probs = jax.vmap(shard_sample)(state.replay, sk)
 
-        # global IS weights: N = total filled slots across shards; the
-        # global sampling probability is approximated as probs/dp (exact
-        # when shard priority masses are balanced, which round-robin
-        # ingest keeps true in expectation)
+        # IS weights against the ACTUAL sampling distribution: a draw
+        # lands in each shard with probability 1/dp (stratified — every
+        # shard contributes exactly b_local draws) and within shard d on
+        # item i with probs = p_i/m_d, so P(i) = probs/dp EXACTLY, even
+        # with skewed shard masses. At beta=1 the weighted estimate is
+        # therefore unbiased toward the uniform target regardless of
+        # skew (tests/test_parallel.py::test_skewed_shard_is_weights —
+        # weighting by the single-global-tree probability p_i/M instead
+        # would bias each shard's contribution by M/(dp*m_d)). What
+        # skew DOES perturb is the sampling distribution itself: items
+        # in a starved shard are over-sampled (and down-weighted);
+        # round-robin ingest keeps masses balanced in expectation, so
+        # the effective prioritization tracks the single-tree recipe.
         n_global = jnp.maximum(
             state.replay.size.astype(jnp.float32).sum(), 1.0)
         w = (n_global * jnp.maximum(probs / self.dp, 1e-12)

@@ -80,6 +80,32 @@ class DiscretePolicyHooks:
         return np.asarray([t.action for t in ts], np.int32)
 
 
+def resolve_pending(pending: list[NStepTransition], v_next: float,
+                    queue_fn: Callable[[NStepTransition, float], None]
+                    ) -> None:
+    """Resolve parked transitions with the just-arrived bootstrap value
+    (max_a Q of each transition's next_obs): their initial priority is
+    the |TD| against the value the actor stashed at selection time.
+    One implementation for the scalar and vector actors — the initial-
+    priority math must never diverge between them."""
+    for t in pending:
+        target = t.reward + t.discount * v_next
+        queue_fn(t, abs(target - float(t.aux)))
+    pending.clear()
+
+
+def ship_flat_outbox(outbox: list[tuple[NStepTransition, float]],
+                     action_array: Callable, actor_index: int,
+                     frames: int, transport) -> None:
+    """Stack an outbox of (transition, priority) into the flat wire
+    batch and send it — the shipping tail shared by the scalar and
+    vector actors."""
+    ts = [t for t, _ in outbox]
+    pris = np.asarray([p for _, p in outbox], np.float32)
+    transport.send_experience(flat_transition_batch(
+        ts, pris, action_array(ts), actor_index, frames))
+
+
 class ContinuousPolicyHooks:
     """Ape-X DPG policy hooks shared by the scalar and vector actors:
     deterministic mu(s) + Gaussian exploration noise (Horgan et al.
@@ -167,11 +193,8 @@ class Actor(DiscretePolicyHooks):
             self._outbox.append((t, priority))
 
     def _resolve_pending(self, out) -> None:
-        v_next = self._bootstrap_value(out)
-        for t in self._pending:
-            target = t.reward + t.discount * v_next
-            self._queue(t, abs(target - float(t.aux)))
-        self._pending.clear()
+        resolve_pending(self._pending, self._bootstrap_value(out),
+                        self._queue)
 
     def _route(self, transitions: list[NStepTransition],
                terminal_obs: np.ndarray | None) -> None:
@@ -206,13 +229,10 @@ class Actor(DiscretePolicyHooks):
             return
         if not force and len(self._outbox) < self.cfg.actors.ingest_batch:
             return
-        ts = [t for t, _ in self._outbox]
-        pris = np.asarray([p for _, p in self._outbox], np.float32)
-        batch = flat_transition_batch(ts, pris, self._action_array(ts),
-                                      self.index, self._frames_unshipped)
+        ship_flat_outbox(self._outbox, self._action_array, self.index,
+                         self._frames_unshipped, self.transport)
         self._outbox = []
         self._frames_unshipped = 0
-        self.transport.send_experience(batch)
 
     # -- main loop ---------------------------------------------------------
 

@@ -200,9 +200,10 @@ class ApexDriver:
         self._profiling: bool | None = False if cfg.profile_dir else None
         self._profile_from = 0
         self.last_eval: dict | None = None
-        # checkpoint/resume (SURVEY.md §5): params/targets/opt/rng/step are
-        # saved; replay contents are not (large, and Ape-X tolerates
-        # refilling it — the actors regenerate experience on resume)
+        # checkpoint/resume (SURVEY.md §5): params/targets/opt/rng/step
+        # always; replay contents too when cfg.checkpoint_replay (off by
+        # default — large, and Ape-X tolerates refilling; opt in to skip
+        # the min_fill stall and keep the replay distribution continuous)
         self.ckpt = (CheckpointManager(cfg.checkpoint_dir)
                      if cfg.checkpoint_dir else None)
         if self.ckpt is not None:
@@ -221,17 +222,23 @@ class ApexDriver:
             return jnp.copy(jax.random.key_data(x))
         return jnp.copy(x)
 
-    def _ckpt_payload(self) -> dict:
-        """Host copy of the train state minus replay, donation-safe.
+    def _ckpt_payload(self, with_replay: bool | None = None) -> dict:
+        """Host copy of the train state, donation-safe. Replay contents
+        ride along only when cfg.checkpoint_replay (they dominate the
+        payload size — see the config comment); restores override
+        `with_replay` to follow what the checkpoint actually saved.
 
         Only a fast on-device jnp.copy happens under the state lock (an
         aliased buffer would be deleted by the next donating train/add
         jit); the device->host transfer for the Orbax write runs outside
         it so checkpointing never stalls the learner hot loop."""
+        if with_replay is None:
+            with_replay = self.cfg.checkpoint_replay
+        skip = () if with_replay else ("replay",)
         with self._state_lock:
             dev = {k: jax.tree.map(self._dev_copy, v)
                    for k, v in self.state._asdict().items()
-                   if k != "replay"}
+                   if k not in skip}
         return {k: jax.tree.map(np.asarray, v) for k, v in dev.items()}
 
     def _save_checkpoint(self, wait: bool = False) -> None:
@@ -241,7 +248,16 @@ class ApexDriver:
     def _maybe_restore(self) -> None:
         if self.ckpt.latest_step() is None:
             return  # fresh start: skip building the (host-copy) template
-        template = self._ckpt_payload()
+        # the template must mirror what was SAVED, not the current
+        # checkpoint_replay flag: a toggled flag would otherwise hand
+        # Orbax a structure-mismatched template and brick resume. The
+        # flag governs saves; restores follow the file (an old
+        # replay-bearing checkpoint restores its contents even with the
+        # flag now off). Unknowable metadata falls back to the flag.
+        saved = self.ckpt.item_keys()
+        with_replay = (("replay" in saved) if saved is not None
+                       else self.cfg.checkpoint_replay)
+        template = self._ckpt_payload(with_replay=with_replay)
         restored = self.ckpt.restore(template=template)
         if restored is None:
             return
@@ -259,6 +275,12 @@ class ApexDriver:
                 for k, v in restored.items()}
             self.state = self.state._replace(**put)
         self._grad_steps_total = int(np.asarray(restored["step"]))
+        if "replay" in restored:
+            # restored contents: the learner can resume training
+            # immediately instead of re-paying the min_fill stall
+            with self._lock:
+                self._replay_filled = int(
+                    np.sum(np.asarray(restored["replay"].size)))
         self._publish_params()
 
     # -- components --------------------------------------------------------

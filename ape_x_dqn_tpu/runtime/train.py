@@ -101,6 +101,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "hot loop into this directory")
     ap.add_argument("--metrics-file", default=None,
                     help="JSONL metrics sink")
+    ap.add_argument("--tensorboard-dir", default=None,
+                    help="also write TensorBoard event files here "
+                         "(JSONL stays canonical; needs torch or "
+                         "tensorboardX for the writer)")
     ap.add_argument("--eval-only", action="store_true",
                     help="no training: restore the latest checkpoint and "
                          "run greedy eval (the full HNS suite for Atari "
@@ -171,7 +175,8 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(out))
         return 0
 
-    metrics = Metrics(log_path=args.metrics_file)
+    metrics = Metrics(log_path=args.metrics_file,
+                      tensorboard_dir=args.tensorboard_dir)
     transport = server = None
     if args.listen and not args.single_process:
         from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer

@@ -41,7 +41,7 @@ from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
 from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
 from ape_x_dqn_tpu.runtime.actor import (
     ContinuousPolicyHooks, DiscretePolicyHooks, actor_epsilon,
-    flat_transition_batch)
+    resolve_pending, ship_flat_outbox)
 
 
 class _EnvCore:
@@ -125,11 +125,8 @@ class VectorActor(DiscretePolicyHooks):
     def _resolve_pending(self, core: _EnvCore, out) -> None:
         if not core.pending:
             return
-        v_next = self._bootstrap_value(out)
-        for t in core.pending:
-            target = t.reward + t.discount * v_next
-            self._queue(core, t, abs(target - float(t.aux)))
-        core.pending.clear()
+        resolve_pending(core.pending, self._bootstrap_value(out),
+                        lambda t, p: self._queue(core, t, p))
 
     def _ship(self, force: bool = False) -> None:
         if any(c.seg is not None for c in self.cores):
@@ -146,13 +143,10 @@ class VectorActor(DiscretePolicyHooks):
             return
         if not force and len(self._outbox) < self.cfg.actors.ingest_batch:
             return
-        ts = [t for t, _ in self._outbox]
-        pris = np.asarray([p for _, p in self._outbox], np.float32)
-        batch = flat_transition_batch(ts, pris, self._action_array(ts),
-                                      self.index, self._frames_unshipped)
+        ship_flat_outbox(self._outbox, self._action_array, self.index,
+                         self._frames_unshipped, self.transport)
         self._outbox = []
         self._frames_unshipped = 0
-        self.transport.send_experience(batch)
 
     # -- main loop ---------------------------------------------------------
 

@@ -43,6 +43,36 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
+    def item_keys(self, step: int | None = None) -> set[str] | None:
+        """Top-level keys of a saved checkpoint's pytree, or None when
+        unknowable. Lets a restore build its template from what was
+        actually SAVED — e.g. toggling RunConfig.checkpoint_replay
+        between runs must not brick resume with an Orbax structure
+        mismatch (the flag governs saves; restores follow the file)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None
+        # in-memory metadata works once THIS manager has saved; a fresh
+        # manager over an existing directory cannot infer the handler
+        # (item_metadata returns tree=None), so fall back to orbax's
+        # on-disk _METADATA, whose tree_metadata entries carry each
+        # leaf's key path
+        try:
+            meta = self._mngr.item_metadata(step)
+            tree = getattr(meta, "tree", meta)
+            if tree is not None:
+                return set(tree.keys())
+        except Exception:
+            pass
+        import json
+        path = os.path.join(self._dir, str(step), "default", "_METADATA")
+        try:
+            with open(path) as fh:
+                tm = json.load(fh)["tree_metadata"]
+            return {e["key_metadata"][0]["key"] for e in tm.values()}
+        except Exception:  # layout varies across orbax versions
+            return None
+
     def close(self) -> None:
         self._mngr.wait_until_finished()
         self._mngr.close()

@@ -54,15 +54,37 @@ class Throughput:
 
 
 class Metrics:
-    """Thread-safe scalar metric sink with JSONL persistence."""
+    """Thread-safe scalar metric sink: JSONL is canonical, TensorBoard
+    event files optional (SURVEY.md §5 metrics: "CSV/JSONL +
+    TensorBoard").
 
-    def __init__(self, log_path: str | None = None):
+    tensorboard_dir gates on a writer import (torch's bundled
+    SummaryWriter, present wherever torch is; tensorboardX as a
+    fallback) — asking for event files without either installed is a
+    loud error, not a silent no-op."""
+
+    def __init__(self, log_path: str | None = None,
+                 tensorboard_dir: str | None = None):
         self._latest: dict[str, Any] = {}
         self._lock = threading.Lock()
         self._fh: IO[str] | None = None
         if log_path:
             os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
             self._fh = open(log_path, "a", buffering=1)
+        self._tb = None
+        if tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError:
+                try:
+                    from tensorboardX import SummaryWriter  # type: ignore
+                except ImportError as e:
+                    raise ImportError(
+                        "tensorboard_dir needs an event-file writer: "
+                        "install torch (torch.utils.tensorboard) or "
+                        "tensorboardX, or drop the flag — JSONL logging "
+                        "works without either") from e
+            self._tb = SummaryWriter(tensorboard_dir)
 
     def log(self, step: int, **scalars: Any) -> None:
         rec = {"step": int(step), "time": time.time()}
@@ -78,6 +100,11 @@ class Metrics:
             self._latest.update(rec)
             if self._fh is not None:
                 self._fh.write(json.dumps(rec) + "\n")
+            if self._tb is not None:
+                for k, v in rec.items():
+                    if k not in ("step", "time") and isinstance(
+                            v, (int, float)):
+                        self._tb.add_scalar(k, v, int(step))
 
     def latest(self) -> dict[str, Any]:
         with self._lock:
@@ -88,6 +115,9 @@ class Metrics:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+            if self._tb is not None:
+                self._tb.close()
+                self._tb = None
 
 
 # Atari-57 human / random score table for the human-normalized-score (HNS)

@@ -63,6 +63,78 @@ def test_driver_saves_and_resumes(tmp_path):
     assert d2.ckpt.latest_step() == out2["grad_steps"]
 
 
+def test_replay_contents_checkpoint_skips_min_fill(tmp_path):
+    """Opt-in replay checkpointing (SURVEY.md §5 'and (optionally)
+    replay contents'): a resumed driver restores the device ReplayState
+    and can train IMMEDIATELY — no re-ingest, no min_fill stall."""
+    cfg = _ckpt_cfg(tmp_path, checkpoint_replay=True)
+    d1 = ApexDriver(cfg)
+    out1 = d1.run(total_env_frames=1500, max_grad_steps=50,
+                  wall_clock_limit_s=120)
+    assert out1["actor_errors"] == [] and out1["loop_errors"] == []
+    filled1 = d1._replay_filled
+    assert filled1 >= cfg.replay.min_fill
+    tree1 = np.asarray(d1.state.replay.tree)
+
+    d2 = ApexDriver(cfg)
+    try:
+        # the restored fill mirror already clears min_fill: the learner
+        # loop would dispatch on its first iteration without any ingest
+        assert d2._replay_filled == filled1
+        assert d2._replay_filled >= d2._min_fill()
+        # device replay state round-trips bitwise (sum-tree included)
+        np.testing.assert_array_equal(np.asarray(d2.state.replay.tree),
+                                      tree1)
+        # and training off the restored contents actually works
+        state, m = d2.learner.train_step(d2.state)
+        assert np.isfinite(float(m["loss"]))
+    finally:
+        d2.server.stop()
+
+
+def test_checkpoint_replay_flag_toggle_does_not_brick_resume(tmp_path):
+    """checkpoint_replay governs SAVES; restores follow what the file
+    contains — toggling the flag between runs must neither crash the
+    Orbax template restore nor lose the saved replay contents."""
+    cfg_off = _ckpt_cfg(tmp_path)
+    d1 = ApexDriver(cfg_off)
+    out1 = d1.run(total_env_frames=1500, max_grad_steps=40,
+                  wall_clock_limit_s=120)
+    assert out1["actor_errors"] == [] and out1["loop_errors"] == []
+
+    # replay-less checkpoint, flag now ON: restore must not mismatch
+    cfg_on = cfg_off.replace(checkpoint_replay=True)
+    d2 = ApexDriver(cfg_on)
+    assert d2._grad_steps_total == out1["grad_steps"]
+    out2 = d2.run(total_env_frames=1500,
+                  max_grad_steps=out1["grad_steps"] + 20,
+                  wall_clock_limit_s=120)
+    assert out2["actor_errors"] == [] and out2["loop_errors"] == []
+
+    # d2's final save carried replay; flag OFF again: the contents
+    # still restore (and future saves would drop them)
+    d3 = ApexDriver(cfg_off)
+    try:
+        assert d3._grad_steps_total == out2["grad_steps"]
+        assert d3._replay_filled > 0
+    finally:
+        d3.server.stop()
+
+
+def test_multihost_rejects_checkpoint_replay():
+    """The multihost driver must reject checkpoint_replay loudly (a
+    silent no-op would break the config's resume promise). The gate
+    sits before the process-count check so it is unit-testable."""
+    import pytest
+
+    from ape_x_dqn_tpu.configs import get_config
+    from ape_x_dqn_tpu.runtime.multihost_driver import MultihostApexDriver
+
+    cfg = get_config("cartpole_smoke").replace(checkpoint_replay=True)
+    with pytest.raises(NotImplementedError, match="single-host only"):
+        MultihostApexDriver(cfg)
+
+
 def test_driver_without_checkpoint_dir_has_no_manager():
     cfg = get_config("cartpole_smoke").replace(
         actors=ActorConfig(num_actors=1),

@@ -68,6 +68,26 @@ def test_metrics_and_throughput(tmp_path):
     assert abs(t.rate(now=1.0) - 20.0) < 1e-6
 
 
+def test_metrics_tensorboard_sink(tmp_path):
+    """Optional TB event-file sink (SURVEY.md §5 metrics row): scalars
+    land in event files while JSONL stays canonical."""
+    import pytest
+    pytest.importorskip("torch.utils.tensorboard")
+    tb_dir = tmp_path / "tb"
+    m = Metrics(log_path=str(tmp_path / "log.jsonl"),
+                tensorboard_dir=str(tb_dir))
+    m.log(1, loss=0.5, note=None)  # non-scalars must be skipped, not die
+    m.log(2, loss=0.25, frames=128)
+    m.close()
+    events = list(tb_dir.glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+    # JSONL canonical stream still intact
+    import json
+    recs = [json.loads(ln) for ln
+            in (tmp_path / "log.jsonl").read_text().splitlines()]
+    assert recs[-1]["loss"] == 0.25 and recs[-1]["frames"] == 128
+
+
 def test_hns():
     assert len(ATARI_HUMAN_RANDOM) == 57
     assert abs(human_normalized_score("pong", 14.6) - 1.0) < 1e-9

@@ -97,7 +97,10 @@ def test_two_process_lockstep_training(tmp_path):
     procs = [_launch(port, pid,
                      ["--total-env-frames", "1600",
                       "--max-grad-steps", "20",
-                      "--metrics-file", str(tmp_path / f"m{pid}.jsonl")])
+                      "--metrics-file", str(tmp_path / f"m{pid}.jsonl"),
+                      # eval on process 0 (host-local, collective-free)
+                      "--set", "eval_every_steps=5",
+                      "--set", "eval_episodes=1"])
              for pid in range(2)]
     outs = []
     for p in procs:
@@ -116,6 +119,13 @@ def test_two_process_lockstep_training(tmp_path):
     assert outs[0]["loss"] == pytest.approx(outs[1]["loss"], rel=1e-5)
     # both hosts actually contributed experience
     assert outs[0]["frames_local"] > 0 and outs[1]["frames_local"] > 0
+    # eval ran on process 0 only, without perturbing the lockstep (the
+    # grad_steps/frames/loss agreement above IS the non-perturbation
+    # check), and its record carries a real return
+    assert outs[0]["eval_error"] is None, outs[0]
+    assert outs[0]["eval"] is not None and \
+        outs[0]["eval"]["episodes"] >= 1, outs[0]
+    assert outs[1]["eval"] is None, outs[1]
     # per-round metrics stream to --metrics-file (publish cadence)
     for pid in range(2):
         lines = (tmp_path / f"m{pid}.jsonl").read_text().splitlines()
