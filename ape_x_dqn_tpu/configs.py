@@ -60,7 +60,13 @@ class ReplayConfig:
     # ingest bandwidth (replay/frame_ring.py; SURVEY.md §7 hard part 2)
     storage: str = "flat"  # flat | frame_ring
     seg_transitions: int = 16  # transitions per shipped frame segment
-    segs_per_add: int = 4      # segments per ingest add dispatch
+    # segments per ingest add dispatch: bigger blocks = fewer add
+    # dispatches contending with train_many for the device queue and
+    # host->device link (the round-3 live soak measured 70 -> 125
+    # grad-steps/s going 4 -> 16 under concurrent ingest; PERF.md
+    # "Live soak"). Latency cost: a block buffers
+    # dp * segs_per_add * seg_transitions transitions host-side.
+    segs_per_add: int = 16
     # R2D2 sequence replay (SURVEY.md §3.4)
     seq_length: int = 80
     seq_overlap: int = 40

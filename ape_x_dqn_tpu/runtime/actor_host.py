@@ -123,6 +123,19 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
 
 def main(argv: list[str] | None = None) -> int:
     import argparse
+    import os
+
+    # actor hosts evaluate the policy on THEIR cpu (no TPU in the
+    # reference's actor machines either) — honor JAX_PLATFORMS through
+    # jax.config because interpreter-startup hooks (sitecustomize TPU
+    # plugins) may have imported jax already, making the env var alone
+    # too late (same dance as parallel/multihost.init_multihost); a
+    # co-located actor host grabbing the learner's chip would otherwise
+    # fight it for the device
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+        jax.config.update("jax_platforms", platforms)
 
     from ape_x_dqn_tpu.configs import get_config
     from ape_x_dqn_tpu.runtime.train import apply_overrides
@@ -133,6 +146,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--actors", type=int, default=None)
     ap.add_argument("--actor-offset", type=int, default=0)
     ap.add_argument("--frames-per-actor", type=int, default=None)
+    ap.add_argument("--param-poll-s", type=float, default=2.0,
+                    help="seconds between parameter pulls from the "
+                         "learner; each pull moves the full param tree "
+                         "over DCN, so on bandwidth-constrained links "
+                         "raise this toward the eps-staleness you can "
+                         "tolerate (Ape-X actors pull every ~400 env "
+                         "steps)")
     ap.add_argument("--set", action="append", default=[],
                     metavar="dotted.key=value")
     args = ap.parse_args(argv)
@@ -140,7 +160,8 @@ def main(argv: list[str] | None = None) -> int:
     host, port = args.connect.rsplit(":", 1)
     out = run_actor_host(cfg, host, int(port), num_actors=args.actors,
                          actor_offset=args.actor_offset,
-                         frames_per_actor=args.frames_per_actor)
+                         frames_per_actor=args.frames_per_actor,
+                         param_poll_s=args.param_poll_s)
     print(out)
     return 1 if out["errors"] else 0
 

@@ -487,10 +487,14 @@ class ApexDriver:
             cls.train_many.lower(learner, self.state, chunk).compile()
         # the inference server's first forward compile otherwise exceeds
         # the actor query timeout on TPU (observed live); vector actors
-        # hit the envs_per_actor bucket on their very first query
-        self.server.warmup(
-            warmup_example(self.family, self.cfg, self.spec),
-            extra_sizes=(self.cfg.actors.envs_per_actor,))
+        # hit the envs_per_actor bucket on their very first query. A
+        # remote-only learner (0 local actors, eval off) never queries
+        # its own server — skip the bucket ladder's minutes of compiles
+        if (self.cfg.actors.num_actors > 0 or self.cfg.eval_every_steps > 0
+                or self.cfg.eval_episodes > 0):
+            self.server.warmup(
+                warmup_example(self.family, self.cfg, self.spec),
+                extra_sizes=(self.cfg.actors.envs_per_actor,))
 
     def _learner_loop(self, max_grad_steps: int) -> None:
         try:
@@ -558,12 +562,17 @@ class ApexDriver:
             self._maybe_profile()
             # fuse up to `chunk` grad-steps into one device dispatch
             # (lax.scan in learner.train_many) without overshooting the
-            # step target or a publish boundary; k is snapped to {chunk, 1}
-            # so exactly two XLA graphs exist in the hot loop
+            # step target; k is snapped to {chunk, 1} so exactly two XLA
+            # graphs exist in the hot loop. Publication fires on BOUNDARY
+            # CROSSINGS rather than exact multiples: forcing the step
+            # counter onto publish_every multiples degraded ~40% of
+            # dispatches to single steps whenever publish_every was not
+            # a chunk multiple, each paying a full host->device dispatch
+            # round-trip — measured live at ~70 grad-steps/s vs ~300+
+            # with whole chunks (publish cadence is a staleness knob;
+            # a few steps late is equivalent)
             done = self._grad_steps_total
-            to_publish = publish_every - (done % publish_every)
-            k = chunk if chunk <= min(max_grad_steps - done,
-                                      to_publish) else 1
+            k = chunk if chunk <= max_grad_steps - done else 1
             with self._state_lock:
                 if k > 1:
                     self.state, m = self.learner.train_many(self.state, k)
@@ -571,7 +580,7 @@ class ApexDriver:
                     self.state, m = self.learner.train_step(self.state)
             self._grad_steps_total += k
             self.grad_steps.add(k)
-            if self._grad_steps_total % publish_every == 0:
+            if done // publish_every != self._grad_steps_total // publish_every:
                 self._publish_params()
             if (self.ckpt is not None and self._grad_steps_total - last_ckpt
                     >= self.cfg.checkpoint_every):
@@ -654,6 +663,7 @@ class ApexDriver:
             evaluator.start()
         for t in threads:
             t.start()
+        saw_remote = False
         try:
             prev_stuck_at = -1  # _ingested_batches at last stuck sighting
             while True:
@@ -664,6 +674,25 @@ class ApexDriver:
                     break
                 if not (learner.is_alive() and ingest.is_alive()):
                     break  # crashed loop: error recorded in loop_errors
+                # remote actor hosts (socket transport): the learner must
+                # outlive its local actors while remotes are connected,
+                # still booting (boot grace for a remote-only learner —
+                # actor-host JAX startup takes ~10s+), or only just
+                # disconnected (quiesced() debounce)
+                if hasattr(self.transport, "active_connections"):
+                    if self.transport.active_connections > 0:
+                        saw_remote = True
+                    booting = (not saw_remote
+                               and self.cfg.actors.num_actors == 0
+                               and time.monotonic() - t0
+                               < self.cfg.actors.remote_boot_grace_s)
+                    remote_quiet = (self.transport.quiesced()
+                                    if hasattr(self.transport, "quiesced")
+                                    else self.transport.active_connections
+                                    == 0)
+                    if booting or not remote_quiet:
+                        time.sleep(0.2)
+                        continue
                 if not any(t.is_alive() for t in threads):
                     # actors finished: drain pending experience, then let
                     # the learner reach a finite grad-step target — UNLESS

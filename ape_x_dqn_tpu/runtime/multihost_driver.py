@@ -366,6 +366,12 @@ class MultihostApexDriver:
             with self._lock:
                 self.actor_errors.append((i, e))
 
+    def _make_eval_worker(self) -> EvalWorker:
+        factory = make_eval_policy_factory(
+            self.family, self.cfg.network.lstm_size, self.server.query)
+        return EvalWorker(self.cfg, self.server.query,
+                          policy_factory=factory)
+
     def _eval_loop(self) -> None:
         """Greedy eval on PROCESS 0 only, between publish boundaries
         (SURVEY.md §2.2 'Eval worker'; round-2 verdict missing #3: the
@@ -377,10 +383,7 @@ class MultihostApexDriver:
         call sequence — the other processes neither know nor care."""
         try:
             every = self.cfg.eval_every_steps
-            factory = make_eval_policy_factory(
-                self.family, self.cfg.network.lstm_size, self.server.query)
-            worker = EvalWorker(self.cfg, self.server.query,
-                                policy_factory=factory)
+            worker = self._make_eval_worker()
             next_at = every
             while not self.stop_event.wait(0.2):
                 if self._grad_steps < next_at:
@@ -603,15 +606,20 @@ class MultihostApexDriver:
                              and self._grad_steps >= cap * frames_global)
                 if filled >= self._min_fill() and not cap_bound \
                         and self._grad_steps < max_grad_steps:
-                    to_publish = publish_every - (self._grad_steps
-                                                  % publish_every)
-                    k = chunk_steps if chunk_steps <= min(
-                        max_grad_steps - self._grad_steps, to_publish) else 1
+                    # whole chunks only; publication fires on boundary
+                    # crossings (see ApexDriver._learner_loop_inner:
+                    # snapping to exact publish multiples degrades
+                    # dispatches to single steps). k is global-derived,
+                    # so every process picks the same k — lockstep-safe.
+                    done = self._grad_steps
+                    k = chunk_steps if chunk_steps <= \
+                        max_grad_steps - done else 1
                     self.state, m = self.learner.train_many(self.state, k)
                     self._grad_steps += k
                     loss = float(m["loss"])
                     progressed = True
-                    if self._grad_steps % publish_every == 0:
+                    if done // publish_every != \
+                            self._grad_steps // publish_every:
                         pub = self._host_params()
                         self.server.update_params(pub, self._grad_steps)
                         self.transport.publish_params(pub, self._grad_steps)
@@ -679,27 +687,24 @@ class MultihostApexDriver:
             t.join(timeout=5)
         if evaluator is not None:
             evaluator.join(timeout=10)
-            # short runs can finish inside one eval poll interval:
-            # guarantee at least one greedy evaluation while the local
-            # inference server is still up (mirrors ApexDriver.run)
-            if (self.last_eval is None and self._grad_steps > 0
-                    and self._eval_error is None):
-                try:
-                    factory = make_eval_policy_factory(
-                        self.family, cfg.network.lstm_size,
-                        self.server.query)
-                    res = EvalWorker(
-                        cfg, self.server.query,
-                        policy_factory=factory).run(
-                            cfg.eval_episodes, deadline_s=60.0)
-                    if res is not None:
-                        self.last_eval = res
-                        self.metrics.log(
-                            self._grad_steps,
-                            avg_eval_return=res["mean_return"],
-                            eval_episodes=res["episodes"])
-                except Exception as e:  # noqa: BLE001
-                    self._eval_error = e
+        # short runs can finish inside one eval poll interval, and
+        # eval_every_steps=0 disables the periodic thread entirely:
+        # guarantee at least one greedy evaluation on process 0 while
+        # the local inference server is still up (mirrors ApexDriver)
+        if (jax.process_index() == 0 and cfg.eval_episodes > 0
+                and self.last_eval is None and self._grad_steps > 0
+                and self._eval_error is None):
+            try:
+                res = self._make_eval_worker().run(cfg.eval_episodes,
+                                                   deadline_s=60.0)
+                if res is not None:
+                    self.last_eval = res
+                    self.metrics.log(
+                        self._grad_steps,
+                        avg_eval_return=res["mean_return"],
+                        eval_episodes=res["episodes"])
+            except Exception as e:  # noqa: BLE001
+                self._eval_error = e
         self.server.stop()
         with self._lock:
             avg_ret = (float(np.mean(self.episode_returns))

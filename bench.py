@@ -186,6 +186,15 @@ def bench_actor_pipeline(num_actors: int = 2, envs_per_actor: int = 16,
     net = build_network(cfg.network, probe.spec)
     params = net.init(component_key(0, "net_init"),
                       jnp.zeros((1, *probe.spec.obs_shape), jnp.uint8))
+    # actor hosts evaluate the policy on THEIR cpu-local server
+    # (runtime/actor_host.py) — never across the learner's host<->TPU
+    # link. Committing the params to a CPU device makes the server's
+    # jit run there, so this measures the deployment configuration
+    # rather than this rig's tunnel round-trip.
+    try:
+        params = jax.device_put(params, jax.devices("cpu")[0])
+    except RuntimeError:
+        pass  # no CPU backend registered: measure on the default device
     server = BatchedInferenceServer(
         net.apply, params, max_batch=cfg.inference.max_batch,
         deadline_ms=cfg.inference.deadline_ms)
