@@ -120,7 +120,21 @@ class SocketIngestServer:
     """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
-                 max_pending: int = 64, idle_grace_s: float = 5.0):
+                 max_pending: int = 64, idle_grace_s: float = 5.0,
+                 param_wire_dtype: str = "bfloat16"):
+        """param_wire_dtype: dtype for float params on the wire.
+        "bfloat16" (default) halves the weight-broadcast bytes — the
+        round-3 soak measured param pulls saturating a bandwidth-
+        constrained link (PERF.md "Live soak" item 3), and actors
+        compute in bf16 anyway (the receiver upcasts to f32, so only
+        the bf16 rounding of the values survives — a behavior-policy
+        perturbation far below the eps-greedy noise floor). Set
+        "float32" for bit-exact distribution."""
+        if param_wire_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"param_wire_dtype must be 'bfloat16' or 'float32', "
+                f"got {param_wire_dtype!r}")
+        self._wire_dtype = param_wire_dtype
         self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
         self._dropped = 0
         self._params: tuple[Any, int] = (None, -1)
@@ -181,13 +195,16 @@ class SocketIngestServer:
         with self._lock:
             if self._params_blob is None:
                 params, version = self._params
+                host = jax_to_numpy(params)
+                if self._wire_dtype == "bfloat16":
+                    host = _downcast_f32(host)
                 self._params_blob = pickle.dumps(
-                    (jax_to_numpy(params), version),
-                    protocol=pickle.HIGHEST_PROTOCOL)
+                    (host, version), protocol=pickle.HIGHEST_PROTOCOL)
             return self._params_blob
 
     def get_params(self) -> tuple[Any, int]:
-        return pickle.loads(self._param_blob())
+        params, version = pickle.loads(self._param_blob())
+        return _upcast_bf16(params), version
 
     @property
     def dropped(self) -> int:
@@ -280,6 +297,36 @@ def jax_to_numpy(params: Any) -> Any:
     return jax.tree.map(np.asarray, params) if params is not None else None
 
 
+def _downcast_f32(tree: Any) -> Any:
+    """float32 leaves -> bfloat16 for the wire (half the bytes; other
+    dtypes — uint8 frames, ints, f64 — pass through untouched)."""
+    import jax
+    import ml_dtypes
+
+    def one(x):
+        x = np.asarray(x)
+        return x.astype(ml_dtypes.bfloat16) if x.dtype == np.float32 \
+            else x
+
+    return jax.tree.map(one, tree) if tree is not None else None
+
+
+def _upcast_bf16(tree: Any) -> Any:
+    """bfloat16 leaves -> float32 at the receiver, so actor-host nets
+    see the param dtype they were initialized with (values carry the
+    bf16 rounding; exactness is not a wire contract — see
+    SocketIngestServer.param_wire_dtype)."""
+    import jax
+    import ml_dtypes
+
+    def one(x):
+        x = np.asarray(x)
+        return x.astype(np.float32) if x.dtype == ml_dtypes.bfloat16 \
+            else x
+
+    return jax.tree.map(one, tree) if tree is not None else None
+
+
 # -- actor-host side --------------------------------------------------------
 
 
@@ -357,7 +404,8 @@ class SocketTransport:
                 self._param_sock = None
                 return None, -1
         try:
-            return pickle.loads(msg[1])
+            params, version = pickle.loads(msg[1])
+            return _upcast_bf16(params), version
         except Exception:
             return None, -1
 

@@ -261,7 +261,9 @@ def _preset_r2d2() -> RunConfig:
                             min_fill=5_000, storage="frame_ring"),
         learner=LearnerConfig(batch_size=64, n_step=5, value_rescale=True,
                               target_sync_every=2500, lr=1e-4),
-        actors=ActorConfig(num_actors=256),
+        # vectorized recurrent actors: one {obs,c,h} query of 16 envs
+        # per vector step (runtime/vector_actor.py:RecurrentVectorActor)
+        actors=ActorConfig(num_actors=256, envs_per_actor=16),
         parallel=ParallelConfig(dp=4, tp=2),
     )
 

@@ -26,8 +26,7 @@ from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
 from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
-from ape_x_dqn_tpu.replay.sequence import (
-    SequenceBuilder, split_priorities, stack_items)
+from ape_x_dqn_tpu.replay.sequence import SequenceBuilder
 
 
 def actor_epsilon(i: int, n: int, base: float = 0.4,
@@ -53,6 +52,40 @@ def flat_transition_batch(ts: list[NStepTransition], pris: np.ndarray,
         "actor": actor_index,
         "frames": frames,
     }
+
+
+def sequence_ship_after(cfg: RunConfig) -> int:
+    """Sequences per shipment: ingest_batch counts TRANSITIONS, so
+    sequences ship in proportionally smaller groups to keep ingest
+    latency comparable (shared by the scalar and vector recurrent
+    actors)."""
+    return max(1, cfg.actors.ingest_batch // cfg.replay.seq_length)
+
+
+def feed_sequence(outbox: list, builder, rec: dict, td: float) -> None:
+    """Append one recurrent step record to a SequenceBuilder, routing
+    any completed sequence items into the outbox — the record schema
+    (obs/action/reward/terminal/pre_state/episode_end) is shared by
+    the scalar and vector recurrent actors."""
+    outbox.extend(builder.append(
+        rec["obs"], rec["action"], rec["reward"], rec["terminal"],
+        rec["pre_state"], td=td, episode_end=rec["episode_end"]))
+
+
+def ship_sequence_outbox(outbox: list, actor_index: int, frames: int,
+                         transport) -> None:
+    """Stack an outbox of sequence items into the wire batch and send
+    it — the sequence shipping tail shared by the scalar and vector
+    recurrent actors (one schema; sequence_item_spec depends on it)."""
+    from ape_x_dqn_tpu.replay.sequence import (
+        split_priorities, stack_items)
+
+    items, pris = split_priorities(outbox)
+    batch = stack_items(items)
+    batch["priorities"] = pris
+    batch["actor"] = actor_index
+    batch["frames"] = frames
+    transport.send_experience(batch)
 
 
 class DiscretePolicyHooks:
@@ -346,10 +379,7 @@ class RecurrentActor(Actor):
             seq_len=cfg.replay.seq_length, overlap=cfg.replay.seq_overlap,
             lstm_size=self.lstm_size, priority_eta=cfg.replay.priority_eta,
             frame_mode=frame_mode)
-        # ingest_batch counts transitions; sequences ship in proportionally
-        # smaller groups so ingest latency stays comparable
-        self.ship_after = max(1, cfg.actors.ingest_batch
-                              // cfg.replay.seq_length)
+        self.ship_after = sequence_ship_after(cfg)
         self._outbox: list[dict] = []  # sequence items, not transitions
 
     def _zero_state(self) -> tuple[np.ndarray, np.ndarray]:
@@ -357,23 +387,17 @@ class RecurrentActor(Actor):
         return z, z.copy()
 
     def _feed(self, rec: dict, td: float) -> None:
-        self._outbox.extend(self.builder.append(
-            rec["obs"], rec["action"], rec["reward"], rec["terminal"],
-            rec["pre_state"], td=td, episode_end=rec["episode_end"]))
+        feed_sequence(self._outbox, self.builder, rec, td)
 
     def _ship(self, force: bool = False) -> None:
         if not self._outbox:
             return
         if not force and len(self._outbox) < self.ship_after:
             return
-        items, pris = split_priorities(self._outbox)
-        batch = stack_items(items)
-        batch["priorities"] = pris
-        batch["actor"] = self.index
-        batch["frames"] = self._frames_unshipped
+        ship_sequence_outbox(self._outbox, self.index,
+                             self._frames_unshipped, self.transport)
         self._outbox = []
         self._frames_unshipped = 0
-        self.transport.send_experience(batch)
 
     # -- main loop ---------------------------------------------------------
 

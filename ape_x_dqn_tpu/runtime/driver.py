@@ -84,8 +84,6 @@ class ApexDriver:
         # owns params init + replay item layout + staging geometry
         # (shared with the multihost driver).
         self.family = family_of(cfg)
-        if cfg.actors.envs_per_actor > 1:
-            actor_class(self.family, vector=True)  # fail fast: r2d2 raises
         setup = family_setup(cfg, self.spec, self.net, obs0)
         params, item_spec = setup.params, setup.item_spec
         self._frame_mode = setup.frame_mode

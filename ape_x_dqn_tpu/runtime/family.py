@@ -31,18 +31,13 @@ def family_of(cfg: RunConfig) -> str:
 def actor_class(family: str, vector: bool = False) -> type:
     """Actor implementation per family. vector=True selects the
     K-envs-per-thread vectorized actors (runtime/vector_actor.py),
-    whose query contract is the server's `query_batch`. The recurrent
-    family has no vector variant yet, and this raise IS the rejection:
-    ApexDriver calls actor_class(family, vector=True) at __init__ to
-    fail fast instead of inside an actor thread."""
+    whose query contract is the server's `query_batch` (the recurrent
+    variant ships {obs, c, h} pytrees with a leading [K] axis)."""
     if vector:
         from ape_x_dqn_tpu.runtime.vector_actor import (
-            ContinuousVectorActor, VectorActor)
-        if family == "r2d2":
-            raise NotImplementedError(
-                "envs_per_actor > 1 is not supported for the recurrent "
-                "(r2d2) family yet; set actors.envs_per_actor=1")
-        return ContinuousVectorActor if family == "dpg" else VectorActor
+            ContinuousVectorActor, RecurrentVectorActor, VectorActor)
+        return {"r2d2": RecurrentVectorActor,
+                "dpg": ContinuousVectorActor}.get(family, VectorActor)
     return {"r2d2": RecurrentActor, "dpg": ContinuousActor}.get(
         family, Actor)
 

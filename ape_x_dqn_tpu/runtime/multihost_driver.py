@@ -105,8 +105,6 @@ class MultihostApexDriver:
                 "single-process with remote actor hosts "
                 "(runtime/actor_host.py)")
         self.metrics = metrics or Metrics()
-        if cfg.actors.envs_per_actor > 1:
-            actor_class(self.family, vector=True)  # fail fast: r2d2 raises
         probe_env = make_env(cfg.env, seed=cfg.seed)
         self.spec = probe_env.spec
         self.net = build_network(cfg.network, self.spec)

@@ -114,6 +114,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: all 57)")
     ap.add_argument("--single-process", action="store_true",
                     help="config-1 style in-process loop (no threads)")
+    ap.add_argument("--param-wire-dtype", default="bfloat16",
+                    choices=("bfloat16", "float32"),
+                    help="dtype for float params on the DCN wire with "
+                         "--listen: bf16 halves the weight-broadcast "
+                         "bytes (receivers upcast; values carry bf16 "
+                         "rounding only); float32 is bit-exact")
     ap.add_argument("--listen", default=None, metavar="HOST:PORT",
                     help="also accept remote actor hosts over TCP")
     # multi-host learner (one process per host, SPMD lockstep over a
@@ -181,7 +187,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.listen and not args.single_process:
         from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
         host, port = args.listen.rsplit(":", 1)
-        server = transport = SocketIngestServer(host, int(port))
+        server = transport = SocketIngestServer(
+            host, int(port), param_wire_dtype=args.param_wire_dtype)
         print(f"ingest listening on {host}:{server.port}",
               file=sys.stderr, flush=True)
     if args.coordinator is not None:

@@ -41,7 +41,8 @@ from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
 from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
 from ape_x_dqn_tpu.runtime.actor import (
     ContinuousPolicyHooks, DiscretePolicyHooks, actor_epsilon,
-    resolve_pending, ship_flat_outbox)
+    feed_sequence, resolve_pending, sequence_ship_after,
+    ship_flat_outbox, ship_sequence_outbox)
 
 
 class _EnvCore:
@@ -223,6 +224,199 @@ class VectorActor(DiscretePolicyHooks):
             except Exception:
                 for core in self.cores:
                     core.pending.clear()  # server down: drop, don't die
+        self._ship(force=True)
+        return self.frames
+
+
+class _RecurrentEnvCore:
+    """Per-env recurrent actor state: eps slot, sequence builder,
+    carried LSTM state, and the one-step-parked record awaiting its
+    1-step TD bootstrap (mirrors runtime.actor.RecurrentActor)."""
+
+    __slots__ = ("eps", "builder", "c", "h", "prev")
+
+    def __init__(self, eps: float, builder, lstm_size: int):
+        self.eps = eps
+        self.builder = builder
+        self.c = np.zeros(lstm_size, np.float32)
+        self.h = np.zeros(lstm_size, np.float32)
+        self.prev: dict | None = None
+
+    def zero_state(self) -> None:
+        self.c = np.zeros_like(self.c)
+        self.h = np.zeros_like(self.h)
+
+
+class RecurrentVectorActor:
+    """R2D2 vector actor: K envs per thread, one batched stateful
+    query per vector step ({obs, c, h} each with a leading [K] axis),
+    per-env SequenceBuilders shipping stored-state sequences.
+
+    Semantics mirror runtime.actor.RecurrentActor exactly per env
+    core — the 1-step pending record, terminal/truncation TD seeds,
+    zeroed state on episode end — with the truncation bootstrap
+    queries of all truncated envs batched into one extra query per
+    vector step (same trick as VectorActor)."""
+
+    def __init__(self, cfg: RunConfig, actor_index: int,
+                 query_fn, transport, seed: int | None = None,
+                 episode_callback=None):
+        from ape_x_dqn_tpu.replay.sequence import SequenceBuilder
+
+        self.cfg = cfg
+        self.index = actor_index
+        self.query = query_fn
+        self.transport = transport
+        seed = cfg.seed if seed is None else seed
+        self.K = max(cfg.actors.envs_per_actor, 1)
+        self.gamma = cfg.learner.gamma
+        self.lstm_size = cfg.network.lstm_size
+        total_slots = cfg.actors.num_actors * self.K
+        frame_mode = cfg.replay.storage == "frame_ring"
+        envs, self.cores = [], []
+        for j in range(self.K):
+            g = actor_index * self.K + j
+            envs.append(make_env(cfg.env, seed=seed * 10_007 + g,
+                                 actor_index=g))
+            if frame_mode:
+                assert len(envs[-1].spec.obs_shape) == 3, \
+                    "frame_ring sequence storage needs [H, W, stack] " \
+                    "pixel obs"
+            self.cores.append(_RecurrentEnvCore(
+                actor_epsilon(g, total_slots, cfg.actors.base_eps,
+                              cfg.actors.eps_alpha),
+                SequenceBuilder(
+                    seq_len=cfg.replay.seq_length,
+                    overlap=cfg.replay.seq_overlap,
+                    lstm_size=self.lstm_size,
+                    priority_eta=cfg.replay.priority_eta,
+                    frame_mode=frame_mode),
+                self.lstm_size))
+        self.venv = SyncVectorEnv(envs)
+        self.spec = self.venv.spec
+        self.rng = np.random.default_rng(seed * 7919 + actor_index)
+        self.episode_callback = episode_callback
+        self.frames = 0
+        self._frames_unshipped = 0
+        self.ship_after = sequence_ship_after(cfg)
+        self._outbox: list[dict] = []
+
+    def _feed(self, core: _RecurrentEnvCore, rec: dict, td: float) -> None:
+        feed_sequence(self._outbox, core.builder, rec, td)
+
+    def _resolve_prev(self, core: _RecurrentEnvCore, q_next) -> None:
+        """The parked record's 1-step TD bootstrap arrives with the
+        next query's Q-values for this env."""
+        if core.prev is None:
+            return
+        td = (core.prev["reward"] + self.gamma * float(np.max(q_next))
+              - core.prev["q_sa"])
+        self._feed(core, core.prev, td)
+        core.prev = None
+
+    def _ship(self, force: bool = False) -> None:
+        if not self._outbox:
+            return
+        if not force and len(self._outbox) < self.ship_after:
+            return
+        ship_sequence_outbox(self._outbox, self.index,
+                             self._frames_unshipped, self.transport)
+        self._outbox = []
+        self._frames_unshipped = 0
+
+    def run(self, max_frames: int,
+            stop_event: threading.Event | None = None) -> int:
+        obs = self.venv.reset()
+        while self.frames < max_frames and not (
+                stop_event is not None and stop_event.is_set()):
+            out = self.query({
+                "obs": obs,
+                "c": np.stack([c.c for c in self.cores]),
+                "h": np.stack([c.h for c in self.cores])}, self.K)
+            q, cs, hs = (np.asarray(out["q"]), np.asarray(out["c"]),
+                         np.asarray(out["h"]))
+            actions = []
+            for j, core in enumerate(self.cores):
+                self._resolve_prev(core, q[j])
+                if self.rng.random() < core.eps:
+                    actions.append(int(self.rng.integers(
+                        self.spec.num_actions)))
+                else:
+                    actions.append(int(np.argmax(q[j])))
+            next_obs, rewards, dones, infos = self.venv.step(actions)
+            self.frames += self.K
+            self._frames_unshipped += self.K
+            # first pass: build records, collect truncation bootstraps
+            recs, trunc_j = [], []
+            for j, core in enumerate(self.cores):
+                info = infos[j]
+                done = bool(dones[j])
+                terminal = bool(info.get("terminal", done))
+                recs.append(dict(
+                    obs=obs[j], action=actions[j],
+                    reward=float(rewards[j]), terminal=terminal,
+                    pre_state=(core.c, core.h),
+                    q_sa=float(q[j][actions[j]]), episode_end=done))
+                if done and not terminal:
+                    trunc_j.append(j)
+            # truncation: the sequence ends (state resets) but the
+            # bootstrap survives — one batched query on the terminated
+            # envs' final observations with their POST-step states
+            v_term: dict[int, float] = {}
+            if trunc_j:
+                tout = self.query({
+                    "obs": np.stack([infos[j]["terminal_obs"]
+                                     for j in trunc_j]),
+                    "c": np.stack([cs[j] for j in trunc_j]),
+                    "h": np.stack([hs[j] for j in trunc_j])},
+                    len(trunc_j))
+                tq = np.asarray(tout["q"])
+                for i, j in enumerate(trunc_j):
+                    v_term[j] = float(np.max(tq[i]))
+            # second pass: route records, advance/reset LSTM state
+            for j, core in enumerate(self.cores):
+                rec = recs[j]
+                if rec["terminal"]:
+                    # bootstrap is zero: TD fully determined now
+                    self._feed(core, rec, rec["reward"] - rec["q_sa"])
+                elif j in v_term:
+                    td = (rec["reward"] + self.gamma * v_term[j]
+                          - rec["q_sa"])
+                    self._feed(core, rec, td)
+                else:
+                    core.prev = rec
+                if dones[j]:
+                    core.zero_state()
+                    if (self.episode_callback
+                            and "episode_return" in infos[j]):
+                        self.episode_callback(self.index, infos[j])
+                else:
+                    core.c, core.h = cs[j], hs[j]
+            obs = next_obs
+            self._ship()
+        # shutdown: resolve parked records with one final batched
+        # forward, flush partial sequence tails, ship everything
+        if any(core.prev is not None for core in self.cores):
+            try:
+                out = self.query({
+                    "obs": obs,
+                    "c": np.stack([c.c for c in self.cores]),
+                    "h": np.stack([c.h for c in self.cores])}, self.K)
+                q = np.asarray(out["q"])
+                for j, core in enumerate(self.cores):
+                    if core.prev is not None:
+                        core.prev["episode_end"] = False
+                        self._resolve_prev(core, q[j])
+            except Exception:  # server down: seed without bootstrap
+                for core in self.cores:
+                    if core.prev is not None:
+                        core.prev["episode_end"] = False
+                        self._feed(core, core.prev,
+                                   core.prev["reward"]
+                                   - core.prev["q_sa"])
+                        core.prev = None
+        for core in self.cores:
+            self._outbox.extend(core.builder.flush())
         self._ship(force=True)
         return self.frames
 

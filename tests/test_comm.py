@@ -88,6 +88,41 @@ def test_socket_transport_experience_and_params():
         server.stop()
 
 
+def test_param_wire_dtype_bf16_halves_blob():
+    """DCN weight broadcast ships f32 params as bf16 (half the bytes —
+    the soak measured param pulls saturating the link) and the
+    receiver upcasts back to f32 with only bf16 rounding applied."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    params = {"w": rng.normal(size=(256, 256)).astype(np.float32),
+              "b": rng.normal(size=256).astype(np.float32),
+              "frames": np.zeros((4, 4), np.uint8)}  # non-float: as-is
+    bf = SocketIngestServer("127.0.0.1", 0)  # default bfloat16
+    f32 = SocketIngestServer("127.0.0.1", 0,
+                             param_wire_dtype="float32")
+    try:
+        bf.publish_params(params, 3)
+        f32.publish_params(params, 3)
+        assert len(bf._param_blob()) < 0.6 * len(f32._param_blob())
+        got, version = bf.get_params()
+        assert version == 3
+        assert got["w"].dtype == np.float32  # receiver upcasts
+        assert got["frames"].dtype == np.uint8
+        # values survive with bf16 rounding only (~2^-8 relative)
+        np.testing.assert_allclose(got["w"], params["w"],
+                                   rtol=1 / 128, atol=1e-6)
+        exact = np.asarray(params["w"]).astype(
+            ml_dtypes.bfloat16).astype(np.float32)
+        np.testing.assert_array_equal(got["w"], exact)
+        # the exact path stays bit-identical
+        got32, _ = f32.get_params()
+        np.testing.assert_array_equal(got32["w"], params["w"])
+    finally:
+        bf.stop()
+        f32.stop()
+
+
 def test_conn_tracking_under_connect_disconnect_hammer():
     """_conns is mutated by the accept + reader threads while the
     multihost idle check reads it (round-2 verdict weak #6): hammer
@@ -98,7 +133,7 @@ def test_conn_tracking_under_connect_disconnect_hammer():
     import threading
     import time
 
-    server = SocketIngestServer("127.0.0.1", 0, idle_grace_s=1.0)
+    server = SocketIngestServer("127.0.0.1", 0, idle_grace_s=2.0)
     stop = threading.Event()
     snapshots: list[int] = []
 
@@ -137,7 +172,13 @@ def test_conn_tracking_under_connect_disconnect_hammer():
         assert snapshots, "concurrent readers never ran"
         # a disconnect just happened: the idle verdict must debounce
         assert not server.quiesced()
-        time.sleep(1.1)
+        # ... and eventually clear. Poll rather than a single sleep:
+        # sockets closed before being accepted can be accepted LATE by
+        # the 0.2s-poll accept loop, refreshing the disconnect stamp
+        # after the settle check (seen flaky under full-suite load)
+        deadline = time.monotonic() + 20
+        while not server.quiesced() and time.monotonic() < deadline:
+            time.sleep(0.2)
         assert server.quiesced()
     finally:
         stop.set()

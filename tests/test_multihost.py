@@ -143,7 +143,9 @@ _R2D2_SETS = [
     "learner.batch_size=16", "learner.n_step=3", "learner.lr=1e-3",
     "learner.target_sync_every=100", "learner.publish_every=10",
     "learner.train_chunk=2",
+    # envs_per_actor=2 routes through RecurrentVectorActor
     "actors.num_actors=1", "actors.base_eps=0.4", "actors.ingest_batch=64",
+    "actors.envs_per_actor=2",
     "inference.max_batch=8", "inference.deadline_ms=1.0",
     "eval_every_steps=0", "eval_episodes=0",
 ]
