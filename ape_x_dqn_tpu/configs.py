@@ -29,6 +29,11 @@ class EnvConfig:
     episodic_life: bool = True
     clip_rewards: bool = True
     max_episode_frames: int = 108_000  # 30 min @ 60Hz, standard ALE cap
+    # Force ALE's 18-action legal set instead of the per-game minimal
+    # set. Auto-enabled for id="atari57" fleets (one shared Q-net across
+    # games with heterogeneous minimal sets), and set by per-game eval
+    # workers evaluating such a net so action indices stay aligned.
+    full_action_set: bool = False
 
 
 @dataclass(frozen=True)
@@ -185,6 +190,15 @@ class RunConfig:
     # (TensorBoard/Perfetto-readable)
     profile_dir: str = ""
     profile_steps: int = 24
+    # Multihost stall watchdog (runtime/multihost_driver.StallWatchdog):
+    # seconds of zero round progress before a host-local diagnostic
+    # fires naming this process; two consecutive silent windows abort
+    # the process so the job restarts from the latest checkpoint
+    # instead of hanging in a dead peer's collective. 0 disables.
+    # Must exceed the slowest legitimate in-loop operation (first-round
+    # XLA compiles when AOT warmup is unavailable, checkpoint gathers
+    # over slow links).
+    multihost_watchdog_s: float = 300.0
 
     def replace(self, **kw: Any) -> "RunConfig":
         return dataclasses.replace(self, **kw)

@@ -146,15 +146,24 @@ class SyntheticAtari(RawAtariEnv):
 
 
 class ALERawEnv(RawAtariEnv):
-    """Real Arcade Learning Environment behind the raw interface."""
+    """Real Arcade Learning Environment behind the raw interface.
 
-    def __init__(self, game: str, seed: int = 0, repeat_action_prob=0.25):
+    full_action_set: use ALE's 18-action legal set instead of the
+    per-game minimal set. Required when one Q-net serves MANY games
+    (the atari57 fleet): minimal sets differ per game (4 for breakout,
+    18 for alien), so a shared net sized off one game's probe env would
+    emit out-of-range action indices on the others; the legal set is
+    valid everywhere (redundant actions just alias NOOP/directions)."""
+
+    def __init__(self, game: str, seed: int = 0, repeat_action_prob=0.25,
+                 full_action_set: bool = False):
         from ale_py import ALEInterface, roms  # type: ignore
         self._ale = ALEInterface()
         self._ale.setInt("random_seed", seed)
         self._ale.setFloat("repeat_action_probability", repeat_action_prob)
         self._ale.loadROM(roms.get_rom_path(game))
-        self._actions = self._ale.getMinimalActionSet()
+        self._actions = (self._ale.getLegalActionSet() if full_action_set
+                         else self._ale.getMinimalActionSet())
         self.num_actions = len(self._actions)
 
     def reset(self) -> np.ndarray:
@@ -325,10 +334,27 @@ def atari_backend(kind: str) -> str:
 
 
 def make_atari(cfg, seed: int = 0, actor_index: int = 0) -> Env:
-    """Build the full preprocessed Atari env from an EnvConfig."""
+    """Build the full preprocessed Atari env from an EnvConfig.
+
+    id="atari57" is the flagship suite id (SURVEY.md §2.1 config 3):
+    the actor fleet spreads round-robin across the 57 games by global
+    actor slot — vector actors pass their per-env global slot here, so
+    a 256-thread x 16-env fleet covers every game ~72x."""
     game = cfg.id
+    multi_game = game == "atari57"
+    if multi_game:
+        from ape_x_dqn_tpu.utils.metrics import ATARI_HUMAN_RANDOM
+        games = sorted(ATARI_HUMAN_RANDOM)
+        game = games[actor_index % len(games)]
     if atari_backend(cfg.kind) == "ale":
-        raw: RawAtariEnv = ALERawEnv(_gym_id_to_ale(game), seed=seed)
+        # multi-game fleets share one Q-net, so every game exposes the
+        # same 18-action legal set (see ALERawEnv.full_action_set);
+        # cfg.full_action_set carries the same property into per-game
+        # eval envs built from a multi-game config
+        raw: RawAtariEnv = ALERawEnv(
+            _gym_id_to_ale(game), seed=seed,
+            full_action_set=multi_game or getattr(
+                cfg, "full_action_set", False))
     else:
         raw = SyntheticAtari(seed=seed * 9973 + actor_index)
     return AtariPreprocessing(

@@ -180,6 +180,13 @@ class BatchedInferenceServer:
             return self._params_version
 
     @property
+    def queue_depth(self) -> int:
+        """Requests waiting right now — drivers log this around eval
+        episodes to surface eval-induced actor back-pressure (the eval
+        worker shares this server with the actors)."""
+        return self._q.qsize()
+
+    @property
     def stats(self) -> dict:
         return {"batches": self._batches_served,
                 "items": self._items_served,

@@ -26,7 +26,8 @@ from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
 from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
-from ape_x_dqn_tpu.replay.sequence import SequenceBuilder
+from ape_x_dqn_tpu.replay.sequence import (
+    SequenceBuilder, split_priorities, stack_items)
 
 
 def actor_epsilon(i: int, n: int, base: float = 0.4,
@@ -77,9 +78,6 @@ def ship_sequence_outbox(outbox: list, actor_index: int, frames: int,
     """Stack an outbox of sequence items into the wire batch and send
     it — the sequence shipping tail shared by the scalar and vector
     recurrent actors (one schema; sequence_item_spec depends on it)."""
-    from ape_x_dqn_tpu.replay.sequence import (
-        split_priorities, stack_items)
-
     items, pris = split_priorities(outbox)
     batch = stack_items(items)
     batch["priorities"] = pris

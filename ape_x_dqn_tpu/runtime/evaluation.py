@@ -40,6 +40,11 @@ class EvalWorker:
         self.cfg = cfg
         env_cfg = cfg.env
         if game is not None:
+            if env_cfg.id == "atari57":
+                # a per-game eval env for a multi-game net must keep
+                # the shared 18-action legal set the net was sized for
+                env_cfg = dataclasses.replace(env_cfg,
+                                              full_action_set=True)
             env_cfg = dataclasses.replace(env_cfg, id=game)
         if env_cfg.kind in ("atari", "synthetic_atari"):
             env_cfg = dataclasses.replace(env_cfg, episodic_life=False,

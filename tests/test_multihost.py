@@ -71,6 +71,46 @@ def test_frame_budget_terminates_when_total_unreachable():
     assert outs[0]["frames"] > 0
 
 
+def test_stall_watchdog_fires_and_aborts():
+    """StallWatchdog (round-2 verdict weak #8): silence past the
+    timeout emits a diagnostic naming the process; two consecutive
+    silent windows invoke the fatal action; stamps reset strikes."""
+    import time as _time
+
+    from ape_x_dqn_tpu.runtime.multihost_driver import StallWatchdog
+
+    events, codes = [], []
+    wd = StallWatchdog(0.3, describe=lambda: "state-snapshot",
+                       fatal=codes.append, emit=events.append)
+    wd.start()
+    try:
+        # keep stamping: must never fire
+        for _ in range(4):
+            _time.sleep(0.15)
+            wd.stamp()
+        assert events == [] and codes == []
+        # go silent: strike 1 (diagnostic), then strike 2 (fatal)
+        deadline = _time.monotonic() + 5
+        while len(codes) == 0 and _time.monotonic() < deadline:
+            _time.sleep(0.05)
+        assert len(events) >= 2, events
+        assert "state-snapshot" in events[0]
+        assert "no round progress" in events[0]
+        assert codes == [70], codes
+    finally:
+        wd.stop()
+
+
+def test_stall_watchdog_disabled_at_zero():
+    from ape_x_dqn_tpu.runtime.multihost_driver import StallWatchdog
+
+    wd = StallWatchdog(0.0, describe=lambda: "",
+                       fatal=lambda c: None, emit=lambda m: None)
+    wd.start()  # must not start a thread
+    assert not wd._thread.is_alive()
+    wd.stop()
+
+
 def test_multihost_steps_per_frame_cap_binds():
     """learner.steps_per_frame_cap must pace the lockstep learner to
     the GLOBAL frame count (and the fleet must still terminate when the

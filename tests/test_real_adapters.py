@@ -37,6 +37,7 @@ class _FakeALE:
         self._acts = 0
         self._lives = 3
         self._over = True  # must reset_game before acting
+        self._allowed: set = set(self.MINIMAL_SET)
 
     # configuration
     def setInt(self, key, value):
@@ -54,7 +55,13 @@ class _FakeALE:
 
     def getMinimalActionSet(self):
         assert self.rom is not None, "loadROM before getMinimalActionSet"
+        self._allowed = set(self.MINIMAL_SET)
         return list(self.MINIMAL_SET)
+
+    def getLegalActionSet(self):
+        assert self.rom is not None, "loadROM before getLegalActionSet"
+        self._allowed = set(range(18))  # ALE's full legal set: 18
+        return sorted(self._allowed)
 
     # game loop
     def reset_game(self):
@@ -70,8 +77,8 @@ class _FakeALE:
         return frame
 
     def act(self, code):
-        assert code in self.MINIMAL_SET, \
-            f"act({code}) outside the minimal action set"
+        assert code in self._allowed, \
+            f"act({code}) outside the requested action set"
         assert not self._over, "act() after game_over without reset_game"
         self._acts += 1
         reward = 0.0
@@ -187,6 +194,45 @@ def test_ale_full_game_over(fake_ale):
     assert done and info["terminal"] is True
     assert "episode_return" in info and "episode_length" in info
     assert fake_ale[0].game_over()
+
+
+def test_atari57_spreads_actors_across_games(fake_ale):
+    """The flagship id 'atari57' assigns each global actor slot a game
+    round-robin over the 57-game suite (SURVEY.md §2.1 config 3) —
+    without this a real-ALE deployment would ask for a rom literally
+    named 'atari57'."""
+    from ape_x_dqn_tpu.utils.metrics import ATARI_HUMAN_RANDOM
+
+    games = sorted(ATARI_HUMAN_RANDOM)
+    cfg = EnvConfig(id="atari57", kind="atari", max_noop_start=0)
+    for slot in (0, 3, 56, 57):
+        env = make_env(cfg, seed=1, actor_index=slot)
+        rom = fake_ale[-1].rom
+        assert rom == f"/fake/roms/{games[slot % 57]}.bin", (slot, rom)
+        # multi-game fleets share one Q-net: every game exposes the
+        # 18-action LEGAL set, not its own minimal set — without this
+        # a breakout actor argmaxing an 18-dim Q vector from an
+        # alien-sized probe net steps out of range
+        assert env.spec.num_actions == 18
+        env.reset()
+        env.step(17)  # the highest shared index is valid everywhere
+
+
+def test_atari57_eval_worker_keeps_full_action_set(fake_ale):
+    """A per-game EvalWorker built from a multi-game config must keep
+    the 18-action legal set the shared net was sized for — replacing
+    id='atari57' with a specific game would otherwise shrink the env
+    to that game's minimal set and misalign action indices."""
+    from ape_x_dqn_tpu.configs import get_config
+    from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
+
+    cfg = get_config("atari57_apex").replace(
+        env=EnvConfig(id="atari57", kind="atari", max_noop_start=0))
+
+    worker = EvalWorker(cfg, lambda obs: np.zeros(18, np.float32),
+                        game="pong")
+    assert worker.env.spec.num_actions == 18
+    assert fake_ale[-1].rom == "/fake/roms/pong.bin"
 
 
 # -- fake dm_control --------------------------------------------------------
