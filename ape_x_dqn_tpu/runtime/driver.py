@@ -293,14 +293,6 @@ class ApexDriver:
         return EvalWorker(self.cfg, self.server.query, game=game,
                           policy_factory=factory)
 
-    def _eval_rotation(self) -> tuple[bool, tuple[str, ...]]:
-        """Multi-game runs (id='atari57') rotate the periodic eval
-        through the suite — a fixed worker would silently measure only
-        the alphabetically-first game every time."""
-        from ape_x_dqn_tpu.runtime.evaluation import ATARI57_GAMES
-        rotate = (self.cfg.env.id == "atari57"
-                  and self.cfg.env.kind in ("atari", "synthetic_atari"))
-        return rotate, ATARI57_GAMES
 
     def _on_episode(self, actor_index: int, info: dict) -> None:
         with self._lock:
@@ -615,8 +607,10 @@ class ApexDriver:
         """Greedy-eval at every eval_every_steps grad-step boundary
         (SURVEY.md §2.2 'Eval worker'); shares the inference server."""
         try:
+            from ape_x_dqn_tpu.runtime.evaluation import (
+                eval_game_rotation)
             every = self.cfg.eval_every_steps
-            rotate, games = self._eval_rotation()
+            rotate, games = eval_game_rotation(self.cfg)
             worker = None if rotate else self._make_eval_worker()
             next_at = every
             eval_i = 0

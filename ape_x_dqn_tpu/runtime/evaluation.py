@@ -119,6 +119,17 @@ class EvalWorker:
 ATARI57_GAMES: tuple[str, ...] = tuple(sorted(ATARI_HUMAN_RANDOM))
 
 
+def eval_game_rotation(cfg: RunConfig) -> tuple[bool, tuple[str, ...]]:
+    """Whether a run's periodic eval should rotate through the suite,
+    and the game list. Multi-game runs (env id='atari57') must rotate:
+    a fixed eval worker would silently measure only the alphabetically-
+    first game every time. ONE predicate for both drivers — the
+    rotation rule diverging between them is exactly the bug it fixes."""
+    rotate = (cfg.env.id == "atari57"
+              and cfg.env.kind in ("atari", "synthetic_atari"))
+    return rotate, ATARI57_GAMES
+
+
 def make_eval_policy_factory(family: str, lstm_size: int,
                              query_fn: Callable) -> Callable | None:
     """Per-episode eval policy builder per model family (shared by

@@ -447,12 +447,10 @@ class MultihostApexDriver:
         lockstep round loop without perturbing any process's collective
         call sequence — the other processes neither know nor care."""
         try:
-            from ape_x_dqn_tpu.runtime.evaluation import ATARI57_GAMES
+            from ape_x_dqn_tpu.runtime.evaluation import (
+                eval_game_rotation)
             every = self.cfg.eval_every_steps
-            # multi-game runs rotate through the suite (see
-            # ApexDriver._eval_rotation)
-            rotate = (self.cfg.env.id == "atari57" and self.cfg.env.kind
-                      in ("atari", "synthetic_atari"))
+            rotate, games = eval_game_rotation(self.cfg)
             worker = None if rotate else self._make_eval_worker()
             next_at = every
             eval_i = 0
@@ -461,7 +459,7 @@ class MultihostApexDriver:
                     continue
                 game = None
                 if rotate:
-                    game = ATARI57_GAMES[eval_i % len(ATARI57_GAMES)]
+                    game = games[eval_i % len(games)]
                     worker = self._make_eval_worker(game=game)
                     eval_i += 1
                 t_eval = time.monotonic()

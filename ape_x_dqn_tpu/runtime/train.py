@@ -128,6 +128,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="jax.distributed coordinator address")
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--compilation-cache-dir", default=None,
+                    metavar="DIR",
+                    help="persistent XLA compilation cache: the hot "
+                         "jits compile once per (shape, topology) and "
+                         "every later run/restart/resume loads them in "
+                         "milliseconds instead of 20-40s per graph")
     ap.add_argument("--set", action="append", default=[],
                     metavar="dotted.key=value",
                     help="override any config field, e.g. "
@@ -138,6 +144,14 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.compilation_cache_dir:
+        # must be set before any backend compiles; resumed/preempted
+        # runs then skip straight past the warmup compiles
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          args.compilation_cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
     if args.coordinator is not None:
         if args.num_processes is None or args.process_id is None:
             parser.error("--coordinator requires --num-processes and "

@@ -80,13 +80,14 @@ def test_stall_watchdog_fires_and_aborts():
     from ape_x_dqn_tpu.runtime.multihost_driver import StallWatchdog
 
     events, codes = [], []
-    wd = StallWatchdog(0.3, describe=lambda: "state-snapshot",
+    wd = StallWatchdog(1.2, describe=lambda: "state-snapshot",
                        fatal=codes.append, emit=events.append)
     wd.start()
     try:
-        # keep stamping: must never fire
+        # keep stamping well inside the window: must never fire (wide
+        # margins — this box runs tests under heavy contention)
         for _ in range(4):
-            _time.sleep(0.15)
+            _time.sleep(0.2)
             wd.stamp()
         assert events == [] and codes == []
         # go silent: strike 1 (diagnostic), then strike 2 (fatal)

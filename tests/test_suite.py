@@ -1,0 +1,79 @@
+"""Per-game suite trainer (runtime/suite.py): the north-star protocol
+runner — per-game training runs, per-game checkpoints/metrics, honest
+backend-marked aggregation, shard math, and resume-skip."""
+
+import json
+
+import pytest
+
+from ape_x_dqn_tpu.configs import (
+    ActorConfig, EnvConfig, InferenceConfig, LearnerConfig, ReplayConfig,
+    get_config)
+from ape_x_dqn_tpu.runtime.suite import (
+    main as suite_main, run_suite_training, suite_games)
+
+
+def test_suite_games_shard_partition():
+    games = suite_games()
+    assert len(games) == 57
+    shards = [suite_games(shard=(i, 4)) for i in range(4)]
+    assert sum(len(s) for s in shards) == 57
+    assert sorted(g for s in shards for g in s) == sorted(games)
+    with pytest.raises(ValueError):
+        suite_games(shard=(4, 4))
+
+
+def _suite_cfg():
+    return get_config("pong").replace(
+        env=EnvConfig(id="catch", kind="synthetic_atari"),
+        replay=ReplayConfig(kind="prioritized", capacity=4096,
+                            min_fill=64, storage="frame_ring",
+                            seg_transitions=8, segs_per_add=2),
+        learner=LearnerConfig(batch_size=16, n_step=3,
+                              target_sync_every=100, publish_every=20,
+                              train_chunk=4),
+        actors=ActorConfig(num_actors=1, envs_per_actor=2,
+                           ingest_batch=16),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        parallel=get_config("cartpole_smoke").parallel,  # dp=1, tp=1
+        eval_every_steps=0, eval_episodes=1,
+    )
+
+
+def test_suite_training_two_games(tmp_path):
+    out = run_suite_training(
+        _suite_cfg(), str(tmp_path / "suite"),
+        games=("pong", "breakout"),
+        max_grad_steps_per_game=30,
+        wall_clock_limit_s_per_game=120)
+    assert set(out["scores"]) == {"pong", "breakout"}
+    assert out["backends"] == {"pong": "synthetic",
+                               "breakout": "synthetic"}
+    # synthetic backends can never emit the unmarked north-star key
+    assert "median_hns" not in out and "median_hns_synthetic" in out
+    assert out["complete"] is True
+    for g in ("pong", "breakout"):
+        assert not out["per_game"][g]["errors"], out["per_game"][g]
+        assert out["per_game"][g]["grad_steps"] >= 30
+        assert (tmp_path / "suite" / g / "result.json").exists()
+        assert (tmp_path / "suite" / g / "metrics.jsonl").exists()
+        assert (tmp_path / "suite" / g / "ckpt").exists()
+    assert (tmp_path / "suite" / "suite.json").exists()
+
+    # resume: completed games are skipped (result.json short-circuits;
+    # a retrained game would need >=30 more grad steps of wall time)
+    import time
+    t0 = time.monotonic()
+    out2 = run_suite_training(
+        _suite_cfg(), str(tmp_path / "suite"),
+        games=("pong", "breakout"),
+        max_grad_steps_per_game=30,
+        wall_clock_limit_s_per_game=120)
+    assert time.monotonic() - t0 < 5.0, "resume retrained a done game"
+    assert out2["scores"] == out["scores"]
+
+
+def test_suite_rejects_no_eval():
+    with pytest.raises(ValueError, match="eval_episodes"):
+        run_suite_training(_suite_cfg().replace(eval_episodes=0),
+                           "/tmp/unused", games=("pong",))
