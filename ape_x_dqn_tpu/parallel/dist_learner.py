@@ -43,7 +43,6 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ape_x_dqn_tpu.ops import sum_tree
 from ape_x_dqn_tpu.ops.losses import (
     TransitionBatch, make_dqn_loss, make_r2d2_loss)
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
