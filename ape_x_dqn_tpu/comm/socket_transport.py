@@ -156,6 +156,7 @@ class SocketIngestServer:
         self._conns_lock = threading.Lock()
         self._idle_grace_s = idle_grace_s
         self._last_disconnect: float | None = None
+        self._ever_connected = False
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ingest-accept", daemon=True)
         self._accept_thread.start()
@@ -222,6 +223,15 @@ class SocketIngestServer:
         with self._conns_lock:
             return len(self._conns)
 
+    @property
+    def ever_connected(self) -> bool:
+        """True once ANY remote producer has connected — drivers use
+        this for their boot-grace check instead of polling
+        active_connections, which can miss a producer that connected
+        and vanished entirely inside a warmup/compile window."""
+        with self._conns_lock:
+            return self._ever_connected
+
     def quiesced(self) -> bool:
         """True when no remote producer is connected AND none has
         disconnected within the last idle_grace_s. The grace period
@@ -263,6 +273,7 @@ class SocketIngestServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conns_lock:
                 self._conns.append(conn)
+                self._ever_connected = True
             threading.Thread(target=self._reader, args=(conn,),
                              name="ingest-reader", daemon=True).start()
 

@@ -694,9 +694,14 @@ class ApexDriver:
                 # outlive its local actors while remotes are connected,
                 # still booting (boot grace for a remote-only learner —
                 # actor-host JAX startup takes ~10s+), or only just
-                # disconnected (quiesced() debounce)
+                # disconnected (quiesced() debounce). ever_connected,
+                # not a poll of active_connections: a remote that came
+                # and went entirely inside a compile window would
+                # otherwise pin the loop in "booting" for the full grace
                 if hasattr(self.transport, "active_connections"):
-                    if self.transport.active_connections > 0:
+                    if (self.transport.active_connections > 0
+                            or getattr(self.transport, "ever_connected",
+                                       False)):
                         saw_remote = True
                     booting = (not saw_remote
                                and self.cfg.actors.num_actors == 0

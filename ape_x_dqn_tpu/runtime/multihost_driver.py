@@ -495,7 +495,9 @@ class MultihostApexDriver:
         so a capped pump would leave this host unable to ever read
         idle (fleet-wide livelock via the all_idle gate)."""
         conns = getattr(self.transport, "active_connections", 0)
-        if conns > 0:
+        if conns > 0 or getattr(self.transport, "ever_connected", False):
+            # ever_connected catches a producer that connected and
+            # vanished entirely between this loop's observations
             self._saw_remote = True
         producers_live = (
             any(t.is_alive() for t in self._actor_threads) or conns > 0)

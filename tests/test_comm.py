@@ -360,6 +360,59 @@ def test_dpg_remote_actor_host_ships_continuous_experience():
         server.stop()
 
 
+def test_remote_only_learner_waits_then_quiesces():
+    """A learner with ZERO local actors (the soak/deployment topology)
+    must (a) survive the window before any actor host connects (boot
+    grace), (b) train on late-arriving remote experience, and (c)
+    self-terminate via the QUIESCE path once the remote disconnects and
+    the grace window passes — instead of either exiting at t=0 or
+    spinning forever. max_grad_steps stays at the 10**9 sentinel, so
+    only (c) can end the run before the wall-clock limit."""
+    cfg = _learner_cfg(num_local_actors=0).replace(
+        actors=ActorConfig(num_actors=0, remote_boot_grace_s=60.0),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20,
+                              train_chunk=4))
+    server = SocketIngestServer("127.0.0.1", 0, idle_grace_s=1.0)
+    driver = ApexDriver(cfg, transport=server)
+
+    def late_remote():
+        time.sleep(1.5)  # the learner must still be waiting
+        client = SocketTransport("127.0.0.1", server.port)
+        rng = np.random.default_rng(0)
+        for _ in range(10):
+            n = 32
+            client.send_experience({
+                "obs": rng.normal(size=(n, 4)).astype(np.float32),
+                "action": rng.integers(0, 2, n).astype(np.int32),
+                "reward": rng.normal(size=n).astype(np.float32),
+                "next_obs": rng.normal(size=(n, 4)).astype(np.float32),
+                "discount": np.full(n, 0.97, np.float32),
+                "priorities": rng.random(n).astype(np.float32) + 0.1,
+                "actor": 0, "frames": n,
+            })
+        time.sleep(0.5)  # let the reader drain before the socket dies
+        client.close()
+
+    t = threading.Thread(target=late_remote, daemon=True)
+    t.start()
+    try:
+        out = driver.run(total_env_frames=10**9, max_grad_steps=10**9,
+                         wall_clock_limit_s=120)
+        t.join(timeout=10)
+        assert out["loop_errors"] == [], out["loop_errors"]
+        # (a)+(b): the boot grace held the learner alive long enough to
+        # ingest the late remote's 320 transitions and train on them
+        assert out["grad_steps"] > 0, out
+        assert out["frames"] >= 64, out
+        # (c): with no finite step target, only the quiesce/stuck path
+        # can end the run this early — a regression that spins forever
+        # would hit the 120s wall clock instead
+        assert out["wall_s"] < 60, out
+    finally:
+        server.stop()
+
+
 def test_actor_loss_fault_injection():
     """SURVEY.md §5: killing an actor host mid-run must not disturb the
     learner — training reaches its target with no errors."""
