@@ -43,6 +43,7 @@ MSG_PARAMS = 3
 
 _HDR = struct.Struct("<IBIQ")  # magic, type, crc, payload_len
 MAX_PAYLOAD = 1 << 31
+_WARNED_BAD_BLOB = False
 
 
 # -- codec ------------------------------------------------------------------
@@ -308,32 +309,44 @@ def jax_to_numpy(params: Any) -> Any:
     return jax.tree.map(np.asarray, params) if params is not None else None
 
 
+class _Bf16Wire:
+    """Marker wrapping a leaf the SENDER downcast f32->bf16 for the
+    wire. The receiver upcasts exactly these leaves back to float32 and
+    leaves everything else — including params that are legitimately
+    bfloat16 in the model — untouched, so the wire never silently
+    changes a tree's native dtypes (round-3 advisor finding)."""
+
+    __slots__ = ("a",)
+
+    def __init__(self, a):
+        self.a = a
+
+
 def _downcast_f32(tree: Any) -> Any:
-    """float32 leaves -> bfloat16 for the wire (half the bytes; other
-    dtypes — uint8 frames, ints, f64 — pass through untouched)."""
+    """float32 leaves -> bf16 wrapped in _Bf16Wire for the wire (half
+    the bytes; other dtypes — uint8 frames, ints, f64, native bf16 —
+    pass through untouched and untagged)."""
     import jax
     import ml_dtypes
 
     def one(x):
         x = np.asarray(x)
-        return x.astype(ml_dtypes.bfloat16) if x.dtype == np.float32 \
-            else x
+        return _Bf16Wire(x.astype(ml_dtypes.bfloat16)) \
+            if x.dtype == np.float32 else x
 
     return jax.tree.map(one, tree) if tree is not None else None
 
 
 def _upcast_bf16(tree: Any) -> Any:
-    """bfloat16 leaves -> float32 at the receiver, so actor-host nets
-    see the param dtype they were initialized with (values carry the
+    """Restore sender-downcast leaves (_Bf16Wire markers) to float32;
+    every other leaf keeps its wire dtype exactly (values carry the
     bf16 rounding; exactness is not a wire contract — see
     SocketIngestServer.param_wire_dtype)."""
     import jax
-    import ml_dtypes
 
     def one(x):
-        x = np.asarray(x)
-        return x.astype(np.float32) if x.dtype == ml_dtypes.bfloat16 \
-            else x
+        return np.asarray(x.a, dtype=np.float32) \
+            if isinstance(x, _Bf16Wire) else x
 
     return jax.tree.map(one, tree) if tree is not None else None
 
@@ -417,7 +430,19 @@ class SocketTransport:
         try:
             params, version = pickle.loads(msg[1])
             return _upcast_bf16(params), version
-        except Exception:
+        except Exception as e:
+            # an undecodable blob usually means wire-format skew (e.g. a
+            # learner host on a newer build): swallowing it silently
+            # would leave the actor on stale params forever with a
+            # healthy-looking connection — log once per process
+            global _WARNED_BAD_BLOB
+            if not _WARNED_BAD_BLOB:
+                _WARNED_BAD_BLOB = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "param blob undecodable (%r) — version skew between "
+                    "actor and learner hosts? Actor continues on its "
+                    "current params.", e)
             return None, -1
 
     @property

@@ -116,6 +116,36 @@ class EvalWorker:
         }
 
 
+def run_eval_measured(worker: "EvalWorker", episodes: int, server,
+                      stop_event=None,
+                      deadline_s: float | None = None
+                      ) -> tuple[dict | None, int]:
+    """Run worker.run while polling the shared inference server's
+    queue depth at ~20Hz; returns (result, max depth seen DURING the
+    eval). The during-eval max is the back-pressure the eval induces
+    on concurrent actors — a post-eval snapshot mostly reads 0 because
+    actors drain the queue the moment the eval stops querying
+    (round-3 advisor finding on server_queue_depth)."""
+    import threading
+
+    depth = {"max": int(server.queue_depth)}
+    done = threading.Event()
+
+    def poll():
+        while not done.wait(0.05):
+            depth["max"] = max(depth["max"], int(server.queue_depth))
+
+    t = threading.Thread(target=poll, name="eval-depth-poll", daemon=True)
+    t.start()
+    try:
+        res = worker.run(episodes, stop_event=stop_event,
+                         deadline_s=deadline_s)
+    finally:
+        done.set()
+        t.join(timeout=1.0)
+    return res, depth["max"]
+
+
 ATARI57_GAMES: tuple[str, ...] = tuple(sorted(ATARI_HUMAN_RANDOM))
 
 
@@ -128,6 +158,16 @@ def eval_game_rotation(cfg: RunConfig) -> tuple[bool, tuple[str, ...]]:
     rotate = (cfg.env.id == "atari57"
               and cfg.env.kind in ("atari", "synthetic_atari"))
     return rotate, ATARI57_GAMES
+
+
+def final_eval_game(cfg: RunConfig) -> str | None:
+    """The game for a driver's guaranteed end-of-run fallback eval.
+    Multi-game (rotating) configs must not fall back to an unmarked
+    default worker — that silently measures the alphabetically-first
+    game (round-3 advisor finding). ONE helper for both drivers, for
+    the same reason eval_game_rotation is shared."""
+    rotate, games = eval_game_rotation(cfg)
+    return games[0] if rotate else None
 
 
 def make_eval_policy_factory(family: str, lstm_size: int,

@@ -448,7 +448,7 @@ class MultihostApexDriver:
         call sequence — the other processes neither know nor care."""
         try:
             from ape_x_dqn_tpu.runtime.evaluation import (
-                eval_game_rotation)
+                eval_game_rotation, run_eval_measured)
             every = self.cfg.eval_every_steps
             rotate, games = eval_game_rotation(self.cfg)
             worker = None if rotate else self._make_eval_worker()
@@ -463,19 +463,21 @@ class MultihostApexDriver:
                     worker = self._make_eval_worker(game=game)
                     eval_i += 1
                 t_eval = time.monotonic()
-                res = worker.run(self.cfg.eval_episodes,
-                                 stop_event=self.stop_event)
+                res, depth_max = run_eval_measured(
+                    worker, self.cfg.eval_episodes, self.server,
+                    stop_event=self.stop_event)
                 if res is None:  # cancelled mid-eval at shutdown
                     break
                 with self._lock:
                     self.last_eval = res
+                # max queue depth DURING the eval = the back-pressure it
+                # induced (round-3 advisor: post-eval snapshots read ~0)
                 self.metrics.log(self._grad_steps,
                                  avg_eval_return=res["mean_return"],
                                  eval_episodes=res["episodes"],
                                  eval_game=game or self.cfg.env.id,
                                  eval_wall_s=time.monotonic() - t_eval,
-                                 server_queue_depth=
-                                 self.server.queue_depth)
+                                 server_queue_depth_max=depth_max)
                 next_at = (self._grad_steps // every + 1) * every
         except Exception as e:  # noqa: BLE001 - surfaced in run() output
             with self._lock:
@@ -793,14 +795,18 @@ class MultihostApexDriver:
                 and self.last_eval is None and self._grad_steps > 0
                 and self._eval_error is None):
             try:
-                res = self._make_eval_worker().run(cfg.eval_episodes,
-                                                   deadline_s=60.0)
+                from ape_x_dqn_tpu.runtime.evaluation import (
+                    final_eval_game)
+                game = final_eval_game(cfg)
+                res = self._make_eval_worker(game=game).run(
+                    cfg.eval_episodes, deadline_s=60.0)
                 if res is not None:
                     self.last_eval = res
                     self.metrics.log(
                         self._grad_steps,
                         avg_eval_return=res["mean_return"],
-                        eval_episodes=res["episodes"])
+                        eval_episodes=res["episodes"],
+                        eval_game=game or cfg.env.id)
             except Exception as e:  # noqa: BLE001
                 self._eval_error = e
         self.server.stop()

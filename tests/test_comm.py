@@ -123,6 +123,29 @@ def test_param_wire_dtype_bf16_halves_blob():
         f32.stop()
 
 
+def test_native_bf16_leaves_keep_dtype_on_the_wire():
+    """Only leaves the SENDER downcast are upcast at the receiver: a
+    param tree with genuinely-bf16 leaves (e.g. a bf16-param network)
+    must keep them bf16 across the wire under BOTH wire dtypes
+    (round-3 advisor finding: the old receiver upcast every bf16 leaf
+    unconditionally)."""
+    import ml_dtypes
+
+    params = {"w32": np.ones((8, 8), np.float32),
+              "wbf": np.full((8, 8), 1.5, ml_dtypes.bfloat16)}
+    for wire in ("bfloat16", "float32"):
+        srv = SocketIngestServer("127.0.0.1", 0, param_wire_dtype=wire)
+        try:
+            srv.publish_params(params, 1)
+            got, _ = srv.get_params()
+            assert got["w32"].dtype == np.float32, wire
+            assert got["wbf"].dtype == ml_dtypes.bfloat16, wire
+            np.testing.assert_array_equal(
+                got["wbf"].astype(np.float32), 1.5)
+        finally:
+            srv.stop()
+
+
 def test_conn_tracking_under_connect_disconnect_hammer():
     """_conns is mutated by the accept + reader threads while the
     multihost idle check reads it (round-2 verdict weak #6): hammer

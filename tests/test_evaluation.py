@@ -116,4 +116,33 @@ def test_driver_emits_eval_metrics():
     # the periodic loop produced this record)
     if "eval_wall_s" in latest:
         assert latest["eval_wall_s"] > 0
-        assert latest["server_queue_depth"] >= 0
+        assert latest["server_queue_depth_max"] >= 0
+
+
+def test_run_eval_measured_samples_depth_during_eval():
+    """The logged back-pressure must be the max queue depth WHILE the
+    eval runs — the post-eval snapshot always reads ~0 because actors
+    drain the queue the moment the eval stops querying (round-3
+    advisor finding)."""
+    import time
+
+    from ape_x_dqn_tpu.runtime.evaluation import run_eval_measured
+
+    class FakeServer:
+        def __init__(self):
+            self.queue_depth = 0
+
+    class FakeWorker:
+        def __init__(self, server):
+            self.server = server
+
+        def run(self, episodes, stop_event=None, deadline_s=None):
+            self.server.queue_depth = 7  # pressure while eval runs
+            time.sleep(0.3)
+            self.server.queue_depth = 0  # drained the instant it ends
+            return {"episodes": episodes, "mean_return": 1.0}
+
+    srv = FakeServer()
+    res, depth_max = run_eval_measured(FakeWorker(srv), 1, srv)
+    assert res["episodes"] == 1
+    assert depth_max == 7  # the during-eval max, not the post-eval 0
