@@ -1,11 +1,13 @@
 """Continuous-control environments for the Ape-X DPG config.
 
 The reference's config 5 targets DM Control humanoid (SURVEY.md §2.1).
-`dm_control` is not in this image, so the native backend is a pendulum
-swing-up task — the standard minimal continuous-control benchmark with
-the same interface contract (bounded box action, shaped reward). When
-`dm_control` is importable, `DMControlAdapter` exposes any of its domains
-through the same Env API.
+`dm_control` IS importable in this image (verified by training on it —
+PERF.md "Real-physics DPG"): env ids with an underscore
+("pendulum_swingup", "humanoid_stand", any "<domain>_<task>") route to
+`DMControlAdapter`, which runs the real MuJoCo physics behind the Env
+API. The native `PendulumSwingUp` stand-in (id "pendulum", no
+underscore) stays as the dependency-free fast deterministic backend for
+unit tests and images without dm_control.
 """
 
 from __future__ import annotations
@@ -117,7 +119,15 @@ class DMControlAdapter(Env):
 
 
 def make_control(cfg, seed: int = 0) -> Env:
-    if HAVE_DM_CONTROL and "_" in cfg.id:
+    if "_" in cfg.id:
+        # an underscore id explicitly names a dm_control <domain>_<task>;
+        # silently substituting the 3-d synthetic pendulum would train a
+        # completely different task under the requested label
+        if not HAVE_DM_CONTROL:
+            raise ImportError(
+                f"env id {cfg.id!r} names a dm_control task but "
+                f"dm_control is not importable in this environment; "
+                f"use id='pendulum' for the native stand-in")
         domain, task = cfg.id.split("_", 1)
         return DMControlAdapter(domain, task, seed=seed)
     return PendulumSwingUp(seed=seed)

@@ -224,3 +224,19 @@ def test_native_preproc_matches_numpy():
             got = native.preproc(a, b, out, out)
             np.testing.assert_array_equal(got, ref,
                                           err_msg=f"{h}x{w}->{out}")
+
+
+def test_explicit_dm_control_id_errors_without_dm_control(monkeypatch):
+    """An underscore id explicitly names a dm_control task; with
+    dm_control absent it must raise, not silently train the 3-d
+    synthetic pendulum under the requested label."""
+    from ape_x_dqn_tpu.envs import control
+
+    monkeypatch.setattr(control, "HAVE_DM_CONTROL", False)
+    with pytest.raises(ImportError, match="dm_control"):
+        control.make_control(EnvConfig(id="humanoid_stand",
+                                       kind="control"), seed=0)
+    # the no-underscore native stand-in still works
+    env = control.make_control(EnvConfig(id="pendulum", kind="control"),
+                               seed=0)
+    assert env.spec.obs_shape == (3,)
