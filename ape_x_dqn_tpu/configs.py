@@ -97,6 +97,15 @@ class LearnerConfig:
     # grad-steps fused into one train_many dispatch in the driver hot loop
     # (lax.scan on device; no host round-trips between steps)
     train_chunk: int = 8
+    # K-batch sampling relaxation (SURVEY.md §3.3's sample<-update race,
+    # resolved by relaxation): sample K*B items in ONE stratified tree
+    # descent, run K grad-steps over the K chunks, write priorities back
+    # ONCE. Within-chunk priority staleness (chunk j+1's sample does not
+    # see chunk j's TD updates) matches the reference's async
+    # replay-server semantics, where the host sampler always lags the
+    # learner by an update round-trip. 1 = exact per-step semantics.
+    # A/B'd on the real chip: PERF.md "K-batch sampling".
+    sample_chunk: int = 1
     # Pacing: cap grad-steps at this multiple of ingested transitions
     # (None = free-run, the Ape-X default where the learner trains as
     # fast as the device allows). Bounds replay overfit when actors are
@@ -234,7 +243,12 @@ def _preset_pong() -> RunConfig:
         # ~1.6e-3 grad-steps per ingested env step). Without it the
         # 490/s TPU learner free-runs hundreds of epochs over a slow
         # actor fleet's replay — the pathology PERF.md measured live.
-        learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3),
+        # sample_chunk=4: K-batch sampling relaxation, +4% on the real
+        # chip with learning parity on the catch e2e (PERF.md "K-batch
+        # sampling"); the dist learner (atari57 preset) keeps exact
+        # per-step semantics — K-batch is not implemented there yet.
+        learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3,
+                              sample_chunk=4),
         actors=ActorConfig(num_actors=8, envs_per_actor=16),
     )
 

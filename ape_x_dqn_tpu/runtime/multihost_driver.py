@@ -160,7 +160,17 @@ class MultihostApexDriver:
                 "(ApexDriver); the multihost driver checkpoints "
                 "params/opt/rng/step/frames and refills replay on "
                 "resume — set checkpoint_replay=False here")
-        assert jax.process_count() > 1, \
+        # a 1-process fleet is valid ONLY under an initialized
+        # jax.distributed runtime (the CLI's --coordinator path; the
+        # driver artifact certifies the round protocol that way) —
+        # plain single-process training belongs in ApexDriver
+        dist_on = False
+        try:
+            from jax._src import distributed as _dist
+            dist_on = _dist.global_state.client is not None
+        except Exception:  # noqa: BLE001 - internal-API probe only
+            dist_on = False
+        assert jax.process_count() > 1 or dist_on, \
             "MultihostApexDriver requires jax.distributed (use ApexDriver " \
             "for single-process runs)"
         self.cfg = cfg
@@ -823,6 +833,10 @@ class MultihostApexDriver:
             "avg_return": avg_ret,
             "wall_s": time.monotonic() - t0,
             "restored_step": self._restored_step,
+            # grad-step of the last weight publication (0 = never):
+            # lets callers (and dryrun_multichip's round-protocol
+            # certification) assert the publish path actually fired
+            "params_version": self.server.params_version,
             "actor_errors": [f"{i}: {e!r}" for i, e in self.actor_errors],
             "eval": self.last_eval,
             "eval_error": (repr(self._eval_error)

@@ -70,6 +70,12 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
     losses: deque[float] = deque(maxlen=100)
     frames = 0
     grad_steps = 0
+    # K-batch relaxation: bank K training opportunities, then one
+    # train_many(K) macro-dispatch — same grad-steps-per-frame as the
+    # exact path, routed through _train_step_k (learning-parity e2e:
+    # tests/test_e2e_catch.py::test_cnn_learns_catch_kbatch)
+    sample_chunk = max(getattr(cfg.learner, "sample_chunk", 1), 1)
+    train_bank = 0
     eps_final = 0.05
     eps_decay_frames = max(total // 10, 1_000)
 
@@ -111,10 +117,21 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
         if (int(state.replay.size) + len(pending) >= cfg.replay.min_fill
                 and frames % train_every == 0):
             flush()
-            state, m = learner.train_step(state)
-            grad_steps += 1
+            done = grad_steps
+            if sample_chunk > 1:
+                train_bank += 1
+                if train_bank < sample_chunk:
+                    continue
+                train_bank = 0
+                state, m = learner.train_step_k(state, sample_chunk)
+                grad_steps += sample_chunk
+            else:
+                state, m = learner.train_step(state)
+                grad_steps += 1
             losses.append(float(m["loss"]))
-            if grad_steps % 500 == 0:
+            # boundary CROSSING, not equality: K-sized increments would
+            # otherwise only hit exact multiples at lcm(K, 500)
+            if done // 500 != grad_steps // 500:
                 metrics.log(grad_steps, frames=frames,
                             loss=float(m["loss"]),
                             q_mean=float(m["q_mean"]),

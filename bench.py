@@ -33,7 +33,18 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def build_learner(capacity: int, batch_size: int, storage: str):
+def spread(runs) -> dict:
+    """Median + min/max over repeated measurements: single-shot artifacts
+    made round-over-round deltas uninterpretable (round-3 verdict weak
+    #1 — a −66% ingest 'regression' that was probably tunnel
+    contention, unprovable without spread)."""
+    return {"median": round(float(np.median(runs)), 1),
+            "min": round(float(np.min(runs)), 1),
+            "max": round(float(np.max(runs)), 1)}
+
+
+def build_learner(capacity: int, batch_size: int, storage: str,
+                  sample_chunk: int = 1):
     from ape_x_dqn_tpu.configs import LearnerConfig, NetworkConfig
     from ape_x_dqn_tpu.envs.base import EnvSpec
     from ape_x_dqn_tpu.models import build_network
@@ -48,7 +59,7 @@ def build_learner(capacity: int, batch_size: int, storage: str):
     net = build_network(NetworkConfig(kind="nature_cnn", dueling=True), spec)
     params = net.init(component_key(0, "net_init"),
                       jnp.zeros((1, 84, 84, 4), jnp.uint8))
-    lcfg = LearnerConfig(batch_size=batch_size)
+    lcfg = LearnerConfig(batch_size=batch_size, sample_chunk=sample_chunk)
     if storage == "frame_ring":
         replay = FrameRingReplay(capacity=capacity, seg_transitions=16,
                                  n_step=3, obs_shape=spec.obs_shape)
@@ -91,7 +102,7 @@ def _seg_chunk(replay, spec, g: int, rng) -> tuple[dict, object]:
 
 
 def prefill(learner, state, spec, n_items: int, storage: str,
-            chunk: int = 4096):
+            chunk: int = 4096, repeats: int = 3):
     """Fill replay via the real `add` jit, and time the INGEST PATH
     separately from host data generation: one chunk of synthetic
     transitions is generated once, and every dispatch re-lands it from
@@ -115,22 +126,58 @@ def prefill(learner, state, spec, n_items: int, storage: str,
     # compile once
     state = learner.add(state, dev_items, dev_pris)
     jax.block_until_ready(state.replay.tree)
-    t0 = time.monotonic()
-    for _ in range(max(n_dispatch - 1, 1)):
-        items = {k: jnp.asarray(v) for k, v in host_items.items()}
-        state = learner.add(state, items, jnp.asarray(host_pris))
-    jax.block_until_ready(state.replay.tree)
-    dt = time.monotonic() - t0
-    n_done = max(n_dispatch - 1, 1) * per_dispatch
-    log(f"ingest (h2d + add): {n_done / dt:,.0f} items/s, "
+    # measure in `repeats` equal sub-runs for median + spread
+    per_run = max((n_dispatch - 1) // repeats, 1)
+    rates = []
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        for _ in range(per_run):
+            items = {k: jnp.asarray(v) for k, v in host_items.items()}
+            state = learner.add(state, items, jnp.asarray(host_pris))
+        jax.block_until_ready(state.replay.tree)
+        rates.append(per_run * per_dispatch / (time.monotonic() - t0))
+    log(f"ingest (h2d + add): {spread(rates)} items/s, "
         f"{wire_bytes / per_dispatch:,.0f} wire bytes/item "
         f"[{storage}]")
-    return state
+    return state, rates
+
+
+def bench_add_device(learner, state, spec, storage: str,
+                     chunk: int = 4096, repeats: int = 3,
+                     dispatches: int = 8):
+    """On-device add ceiling: the same `add` jit with the staged block
+    ALREADY device-resident, so the h2d link is out of the picture.
+    Separates the op's cost (scatter + sum-tree repair) from the
+    host link (round-3 verdict missing #3 / next-round #8: 'PCIe fixes
+    ingest' was extrapolation until the op itself was measured)."""
+    replay = learner.replay
+    rng = np.random.default_rng(1)
+    if storage == "frame_ring":
+        g = chunk // replay.B
+        dev_items, dev_pris = _seg_chunk(replay, spec, g, rng)
+        per_dispatch = g * replay.B
+    else:
+        dev_items, dev_pris = _flat_chunk(spec, chunk, rng)
+        per_dispatch = chunk
+    jax.block_until_ready(jax.tree.leaves(dev_items)[0])
+    # same shapes as prefill -> add is already compiled
+    state = learner.add(state, dev_items, dev_pris)
+    jax.block_until_ready(state.replay.tree)
+    rates = []
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        for _ in range(dispatches):
+            state = learner.add(state, dev_items, dev_pris)
+        jax.block_until_ready(state.replay.tree)
+        rates.append(dispatches * per_dispatch / (time.monotonic() - t0))
+    log(f"device-resident add: {spread(rates)} transitions/s "
+        f"(block={per_dispatch}, h2d excluded) [{storage}]")
+    return state, rates
 
 
 def bench_learner(learner, state, steps_per_dispatch: int,
-                  dispatches: int,
-                  trace_dir: str | None = None) -> tuple[float, object]:
+                  dispatches: int, repeats: int = 3,
+                  trace_dir: str | None = None):
     # compile + warmup dispatch (excluded from timing AND the trace —
     # a 20-40s compile window would drown the steady-state capture)
     t0 = time.monotonic()
@@ -138,20 +185,67 @@ def bench_learner(learner, state, steps_per_dispatch: int,
     jax.block_until_ready(m["loss"])
     log(f"train_many compile+first dispatch: {time.monotonic() - t0:.1f}s "
         f"(loss={float(m['loss']):.4f})")
-    if trace_dir:
-        jax.profiler.start_trace(trace_dir)
-    t0 = time.monotonic()
-    try:
-        for _ in range(dispatches):
-            state, m = learner.train_many(state, steps_per_dispatch)
-        jax.block_until_ready(m["loss"])
-    finally:
-        if trace_dir:
-            jax.profiler.stop_trace()
-            log(f"profiler trace written to {trace_dir}")
-    dt = time.monotonic() - t0
+    rates = []
+    for r in range(repeats):
+        if trace_dir and r == 0:
+            jax.profiler.start_trace(trace_dir)
+        t0 = time.monotonic()
+        try:
+            for _ in range(dispatches):
+                state, m = learner.train_many(state, steps_per_dispatch)
+            jax.block_until_ready(m["loss"])
+        finally:
+            if trace_dir and r == 0:
+                jax.profiler.stop_trace()
+                log(f"profiler trace written to {trace_dir}")
+        rates.append(steps_per_dispatch * dispatches
+                     / (time.monotonic() - t0))
     assert np.isfinite(float(m["loss"])), "non-finite loss in steady state"
-    return (steps_per_dispatch * dispatches) / dt, state
+    return rates, state
+
+
+def train_step_flops_xla(learner, state,
+                         steps_per_dispatch: int) -> float | None:
+    """XLA's own FLOP count for one fused grad-step (compiler cost
+    analysis of the train_many executable / scan length). On this TPU
+    backend the compiler count omits most conv FLOPs (~0.9 vs ~47
+    analytic GFLOP/step) — reported for cross-reference only; MFU uses
+    the analytic count."""
+    try:
+        # .lower() via the class: the jitted wrapper's lower() does not
+        # re-bind self the way its __call__ does
+        compiled = type(learner).train_many.lower(
+            learner, state, steps_per_dispatch).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost["flops"])
+        return flops / steps_per_dispatch if flops > 0 else None
+    except Exception as e:  # noqa: BLE001 - strictly best-effort
+        log(f"cost_analysis unavailable: {e!r}")
+        return None
+
+
+def train_step_flops_analytic(batch_size: int, num_actions: int = 18,
+                              dense: int = 512) -> float:
+    """Analytic FLOP/step for the flagship dueling Nature-CNN train
+    step (models/qnets.py shapes: 84x84x4 -> conv 32x8s4 -> 64x4s2 ->
+    64x3s1 -> dense 512 -> dueling heads).
+
+    Accounting: the double-DQN loss runs the online net on obs (with
+    gradient: ~3x forward cost for fwd+bwd), the online net on
+    next_obs, and the target net on next_obs (1x each) -> 5x one
+    forward's MACs. 2 FLOPs per MAC. Elementwise/optimizer/replay ops
+    are excluded (they are bandwidth-, not FLOP-bound)."""
+    convs = [  # (out_h, out_w, c_out, k, c_in)
+        (20, 20, 32, 8, 4),
+        (9, 9, 64, 4, 32),
+        (7, 7, 64, 3, 64),
+    ]
+    macs = sum(h * w * co * k * k * ci for h, w, co, k, ci in convs)
+    macs += 7 * 7 * 64 * dense            # torso dense
+    macs += dense * (num_actions + 1)     # dueling heads
+    return 2.0 * macs * batch_size * 5.0
 
 
 def bench_actor_pipeline(num_actors: int = 2, envs_per_actor: int = 16,
@@ -250,18 +344,22 @@ def bench_actor_pipeline(num_actors: int = 2, envs_per_actor: int = 16,
     }
 
 
-def bench_inference(net, spec, batch: int = 64, iters: int = 50) -> float:
+def bench_inference(net, spec, batch: int = 64, iters: int = 50,
+                    repeats: int = 3) -> list[float]:
     """Forwards/s of the inference-server jit at its typical bucket size."""
     params = net.init(jax.random.key(0), jnp.zeros((1, *spec.obs_shape),
                                                    jnp.uint8))
     fwd = jax.jit(net.apply)
     obs = jnp.zeros((batch, *spec.obs_shape), jnp.uint8)
     jax.block_until_ready(fwd(params, obs))
-    t0 = time.monotonic()
-    for _ in range(iters):
-        out = fwd(params, obs)
-    jax.block_until_ready(out)
-    return batch * iters / (time.monotonic() - t0)
+    rates = []
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        for _ in range(iters):
+            out = fwd(params, obs)
+        jax.block_until_ready(out)
+        rates.append(batch * iters / (time.monotonic() - t0))
+    return rates
 
 
 def main() -> None:
@@ -285,21 +383,55 @@ def main() -> None:
                    "(0 disables it)")
     p.add_argument("--actor-count", type=int, default=2)
     p.add_argument("--envs-per-actor", type=int, default=16)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="measurement repeats for median + spread")
+    p.add_argument("--sample-chunk", type=int, default=1,
+                   help="K-batch sampling relaxation "
+                   "(LearnerConfig.sample_chunk): K grad-steps per "
+                   "stratified sample + priority write-back")
+    p.add_argument("--peak-tflops", type=float, default=197.0,
+                   help="chip peak bf16 TFLOP/s for the MFU estimate "
+                   "(v5e-class default)")
     args = p.parse_args()
 
     log(f"devices: {jax.devices()}")
     net, learner, state, spec = build_learner(args.capacity, args.batch_size,
-                                              args.storage)
-    state = prefill(learner, state, spec, args.prefill, args.storage)
+                                              args.storage,
+                                              args.sample_chunk)
+    state, ingest_rates = prefill(learner, state, spec, args.prefill,
+                                  args.storage, repeats=args.repeats)
 
-    gsps, state = bench_learner(learner, state, args.steps_per_dispatch,
-                                args.dispatches, trace_dir=args.profile)
-    log(f"learner: {gsps:.1f} grad-steps/s @ batch {args.batch_size} "
-        f"= {gsps * args.batch_size:,.0f} samples/s "
-        f"(capacity {args.capacity})")
-    fps = bench_inference(net, spec)
-    log(f"inference: {fps:,.0f} forwards/s @ bucket 64")
-    secondary = {"inference_forwards_per_s": round(fps, 1)}
+    rates, state = bench_learner(learner, state, args.steps_per_dispatch,
+                                 args.dispatches, repeats=args.repeats,
+                                 trace_dir=args.profile)
+    gsps = float(np.median(rates))
+    log(f"learner: {spread(rates)} grad-steps/s @ batch "
+        f"{args.batch_size} = {gsps * args.batch_size:,.0f} samples/s "
+        f"(capacity {args.capacity}, sample_chunk {args.sample_chunk})")
+    secondary = {
+        "learner_grad_steps_per_s": spread(rates),
+        "ingest_items_per_s": spread(ingest_rates),
+        "sample_chunk": args.sample_chunk,
+    }
+    flops = train_step_flops_analytic(args.batch_size)
+    achieved_tflops = gsps * flops / 1e12
+    mfu = achieved_tflops / args.peak_tflops
+    log(f"mfu: {flops / 1e9:.2f} GFLOP/step (analytic, 5-forward "
+        f"double-DQN accounting) x {gsps:.0f} steps/s = "
+        f"{achieved_tflops:.1f} TFLOP/s = {100 * mfu:.1f}% of "
+        f"{args.peak_tflops:.0f} peak")
+    secondary["flops_per_step"] = round(flops)
+    secondary["achieved_tflops"] = round(achieved_tflops, 2)
+    secondary["mfu"] = round(mfu, 4)
+    xla_flops = train_step_flops_xla(learner, state,
+                                     args.steps_per_dispatch)
+    if xla_flops is not None:
+        secondary["flops_per_step_xla"] = round(xla_flops)
+    state, add_rates = bench_add_device(learner, state, spec, args.storage)
+    secondary["device_add_transitions_per_s"] = spread(add_rates)
+    inf_rates = bench_inference(net, spec, repeats=args.repeats)
+    log(f"inference: {spread(inf_rates)} forwards/s @ bucket 64")
+    secondary["inference_forwards_per_s"] = spread(inf_rates)
     if args.actor_frames > 0:
         ab = bench_actor_pipeline(args.actor_count, args.envs_per_actor,
                                   args.actor_frames)

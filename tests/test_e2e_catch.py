@@ -70,3 +70,19 @@ def test_cnn_learns_catch_from_pixels():
     out = train_single_process(cfg, train_every=4, solve_return=4.0)
     assert out["episodes"] > 10
     assert out["last20_return"] >= 4.0, out
+
+@pytest.mark.slow
+def test_cnn_learns_catch_kbatch():
+    """Learning parity for the K-batch sampling relaxation
+    (LearnerConfig.sample_chunk=4): the CNN agent must clear the same
+    catch-rate bar as the exact per-step path
+    (test_cnn_learns_catch_from_pixels) with identical frame budget and
+    steps-per-frame ratio — within-chunk priority staleness must not
+    cost learning on this task."""
+    import dataclasses
+    cfg = _catch_cfg(total_frames=20_000)
+    cfg = cfg.replace(learner=dataclasses.replace(cfg.learner,
+                                                  sample_chunk=4))
+    out = train_single_process(cfg, train_every=4, solve_return=4.0)
+    assert out["episodes"] > 10
+    assert out["last20_return"] >= 4.0, out
