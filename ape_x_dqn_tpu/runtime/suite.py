@@ -91,6 +91,15 @@ def train_one_game(cfg: RunConfig, game: str, game_dir: str,
                      max_grad_steps=max_grad_steps,
                      wall_clock_limit_s=wall_clock_limit_s)
     metrics.close()
+    # drop this game's jit executables + GC'd device buffers before the
+    # next game builds its own: 57 sequential in-process drivers
+    # otherwise accumulate compiled graphs until LLVM OOMs mid-suite
+    # (observed at game ~43 of the round-4 full pass)
+    del driver
+    import gc
+    import jax
+    gc.collect()
+    jax.clear_caches()
     return out
 
 
