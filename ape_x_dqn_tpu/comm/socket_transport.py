@@ -138,6 +138,12 @@ class SocketIngestServer:
         self._wire_dtype = param_wire_dtype
         self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
         self._dropped = 0
+        # wire accounting (payload bytes; headers are ~17B noise):
+        # lets a soak/driver publish the link's MB/s budget —
+        # experience in vs params out is THE contended resource on
+        # bandwidth-constrained links (PERF.md "Live soak")
+        self._bytes_in = 0
+        self._bytes_out = 0
         self._params: tuple[Any, int] = (None, -1)
         self._params_blob: bytes | None = pickle.dumps((None, -1))
         self._lock = threading.Lock()
@@ -213,6 +219,16 @@ class SocketIngestServer:
         return self._dropped
 
     @property
+    def bytes_in(self) -> int:
+        """Experience payload bytes received from remote actor hosts."""
+        return self._bytes_in
+
+    @property
+    def bytes_out(self) -> int:
+        """Param blob bytes served to remote actor hosts."""
+        return self._bytes_out
+
+    @property
     def pending(self) -> int:
         return self._q.qsize()
 
@@ -226,10 +242,12 @@ class SocketIngestServer:
 
     @property
     def ever_connected(self) -> bool:
-        """True once ANY remote producer has connected — drivers use
-        this for their boot-grace check instead of polling
+        """True once ANY remote producer has SENT EXPERIENCE — drivers
+        use this for their boot-grace check instead of polling
         active_connections, which can miss a producer that connected
-        and vanished entirely inside a warmup/compile window."""
+        and vanished entirely inside a warmup/compile window. Latching
+        on the first experience message (not on accept) keeps
+        param-only probes from masquerading as producers."""
         with self._conns_lock:
             return self._ever_connected
 
@@ -274,7 +292,6 @@ class SocketIngestServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conns_lock:
                 self._conns.append(conn)
-                self._ever_connected = True
             threading.Thread(target=self._reader, args=(conn,),
                              name="ingest-reader", daemon=True).start()
 
@@ -286,9 +303,22 @@ class SocketIngestServer:
                     return  # peer closed: actor loss is tolerated
                 mtype, payload = msg
                 if mtype == MSG_EXPERIENCE:
+                    # ever_connected latches HERE, not on accept: a
+                    # param-only probe (monitoring, or an actor host
+                    # that died waiting for params) is not a producer,
+                    # and counting it once terminated a remote-only
+                    # learner 0.1s into run() — the probe had come and
+                    # gone during construction, so boot grace was
+                    # skipped and quiesced() read idle (observed in the
+                    # round-4 soak)
+                    with self._conns_lock:
+                        self._ever_connected = True
+                    self._bytes_in += len(payload)
                     self.send_experience(decode_batch(payload))
                 elif mtype == MSG_PARAMS_REQ:
-                    _send_msg(conn, MSG_PARAMS, self._param_blob())
+                    blob = self._param_blob()
+                    self._bytes_out += len(blob)
+                    _send_msg(conn, MSG_PARAMS, blob)
         except (OSError, ValueError):
             return  # dead/corrupt connection: drop it, keep serving others
         finally:
@@ -369,6 +399,8 @@ class SocketTransport:
         self._sock: socket.socket | None = None
         self._param_sock: socket.socket | None = None
         self._dropped = 0
+        self._bytes_out = 0  # experience payload bytes shipped
+        self._bytes_in = 0   # param blob bytes pulled
         # independent locks: a param pull blocking on the network (up to
         # the connect timeout) must not stall the actor threads' experience
         # sends — they use different sockets and share no state
@@ -388,6 +420,7 @@ class SocketTransport:
                     if self._sock is None:
                         self._sock = self._connect()
                     _send_msg(self._sock, MSG_EXPERIENCE, payload)
+                    self._bytes_out += len(payload)
                     return
                 except OSError:
                     if self._sock is not None:
@@ -428,6 +461,7 @@ class SocketTransport:
                 self._param_sock = None
                 return None, -1
         try:
+            self._bytes_in += len(msg[1])
             params, version = pickle.loads(msg[1])
             return _upcast_bf16(params), version
         except Exception as e:
@@ -448,6 +482,16 @@ class SocketTransport:
     @property
     def dropped(self) -> int:
         return self._dropped
+
+    @property
+    def bytes_out(self) -> int:
+        """Experience payload bytes shipped to the learner host."""
+        return self._bytes_out
+
+    @property
+    def bytes_in(self) -> int:
+        """Param blob bytes pulled from the learner host."""
+        return self._bytes_in
 
     @property
     def pending(self) -> int:

@@ -118,6 +118,8 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     transport.close()
     return {"frames": sum(frames), "actors": n,
             "dropped": transport.dropped, "errors": errors,
+            "bytes_out": transport.bytes_out,
+            "param_bytes_in": transport.bytes_in,
             "last_param_version": server.params_version}
 
 

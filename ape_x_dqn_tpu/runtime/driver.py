@@ -591,6 +591,15 @@ class ApexDriver:
                     avg_ret = (float(np.mean(self.episode_returns))
                                if self.episode_returns else 0.0)
                     replay_size = self._replay_filled
+                extra = {}
+                # DCN wire budget, when the transport accounts it
+                # (socket ingest): lets a soak attribute the link's
+                # MB/s between experience in and param pulls out
+                for attr, key in (("bytes_in", "ingest_bytes_in"),
+                                  ("bytes_out", "param_bytes_out")):
+                    v = getattr(self.transport, attr, None)
+                    if v is not None:
+                        extra[key] = v
                 self.metrics.log(
                     self._grad_steps_total,
                     loss=float(m["loss"]), q_mean=float(m["q_mean"]),
@@ -599,7 +608,8 @@ class ApexDriver:
                     grad_steps_per_s=self.grad_steps.rate(),
                     avg_return=avg_ret,
                     replay_size=replay_size,
-                    ingest_dropped=self.transport.dropped)
+                    ingest_dropped=self.transport.dropped,
+                    **extra)
         # NOTE: a capture still open here (short run ending inside the
         # profile window) is closed by _learner_loop's finally
 

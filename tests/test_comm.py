@@ -460,3 +460,33 @@ def test_actor_loss_fault_injection():
         if proc.poll() is None:
             proc.kill()
         server.stop()
+
+
+def test_param_only_probe_is_not_a_producer():
+    """ever_connected must latch on the first EXPERIENCE message, not
+    on accept: a param-only client (monitoring probe, or an actor host
+    that died waiting for params) that comes and goes during learner
+    construction would otherwise skip the boot grace AND read as a
+    departed producer — observed terminating a remote-only learner
+    0.1s into its run (round-4 soak)."""
+    server = SocketIngestServer("127.0.0.1", 0)
+    client = SocketTransport("127.0.0.1", server.port)
+    try:
+        server.publish_params({"w": np.ones(2, np.float32)}, 1)
+        params, _ = client.get_params()   # param-only connection
+        assert params is not None
+        client.close()
+        time.sleep(0.3)
+        assert server.ever_connected is False  # probe, not producer
+
+        client2 = SocketTransport("127.0.0.1", server.port)
+        client2.send_experience({"obs": np.zeros((2, 2), np.float32),
+                                 "priorities": np.ones(2, np.float32),
+                                 "frames": 2})
+        got = server.recv_experience(timeout=5.0)
+        assert got is not None
+        assert server.ever_connected is True   # real producer
+        client2.close()
+    finally:
+        client.close()
+        server.stop()
