@@ -160,6 +160,41 @@ def eval_game_rotation(cfg: RunConfig) -> tuple[bool, tuple[str, ...]]:
     return rotate, ATARI57_GAMES
 
 
+class RollingSuiteScore:
+    """Rolling per-game score table for the multi-game eval rotation.
+
+    The rotation evaluates ONE game per eval event, so a full suite
+    view previously needed an offline `--eval-only` pass over all 57
+    (round-3 verdict weak #7). This keeps the latest unclipped return
+    per game and exposes a rolling backend-marked median-HNS over the
+    games seen so far — the same honesty split as evaluate_suite: the
+    unqualified key never appears for synthetic backends, and the
+    rolling key is additionally marked `rolling_` because it medians
+    only the games evaluated so far this run."""
+
+    def __init__(self, cfg: RunConfig):
+        from ape_x_dqn_tpu.envs.atari import atari_backend
+
+        self._backend = atari_backend(cfg.env.kind)
+        self._scores: dict[str, float] = {}
+
+    def update(self, game: str, mean_return: float) -> dict:
+        """Record a game's latest eval; returns metric fields to log."""
+        self._scores[game] = float(mean_return)
+        known = {g: s for g, s in self._scores.items()
+                 if g in ATARI_HUMAN_RANDOM}
+        key = ("rolling_median_hns" if self._backend == "ale"
+               else "rolling_median_hns_synthetic")
+        out = {"eval_games_seen": len(self._scores)}
+        if known:
+            out[key] = median_hns(known)
+        return out
+
+    @property
+    def scores(self) -> dict[str, float]:
+        return dict(self._scores)
+
+
 def final_eval_game(cfg: RunConfig) -> str | None:
     """The game for a driver's guaranteed end-of-run fallback eval.
     Multi-game (rotating) configs must not fall back to an unmarked

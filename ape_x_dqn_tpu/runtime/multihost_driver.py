@@ -458,10 +458,11 @@ class MultihostApexDriver:
         call sequence — the other processes neither know nor care."""
         try:
             from ape_x_dqn_tpu.runtime.evaluation import (
-                eval_game_rotation, run_eval_measured)
+                RollingSuiteScore, eval_game_rotation, run_eval_measured)
             every = self.cfg.eval_every_steps
             rotate, games = eval_game_rotation(self.cfg)
             worker = None if rotate else self._make_eval_worker()
+            rolling = RollingSuiteScore(self.cfg) if rotate else None
             next_at = every
             eval_i = 0
             while not self.stop_event.wait(0.2):
@@ -481,13 +482,17 @@ class MultihostApexDriver:
                 with self._lock:
                     self.last_eval = res
                 # max queue depth DURING the eval = the back-pressure it
-                # induced (round-3 advisor: post-eval snapshots read ~0)
+                # induced (round-3 advisor: post-eval snapshots read ~0);
+                # rolling suite table per round-3 weak #7
+                roll = (rolling.update(game, res["mean_return"])
+                        if rolling is not None and game else {})
                 self.metrics.log(self._grad_steps,
                                  avg_eval_return=res["mean_return"],
                                  eval_episodes=res["episodes"],
                                  eval_game=game or self.cfg.env.id,
                                  eval_wall_s=time.monotonic() - t_eval,
-                                 server_queue_depth_max=depth_max)
+                                 server_queue_depth_max=depth_max,
+                                 **roll)
                 next_at = (self._grad_steps // every + 1) * every
         except Exception as e:  # noqa: BLE001 - surfaced in run() output
             with self._lock:
