@@ -713,14 +713,17 @@ class ApexDriver:
                 # outlive its local actors while remotes are connected,
                 # still booting (boot grace for a remote-only learner —
                 # actor-host JAX startup takes ~10s+), or only just
-                # disconnected (quiesced() debounce). ever_connected,
-                # not a poll of active_connections: a remote that came
-                # and went entirely inside a compile window would
-                # otherwise pin the loop in "booting" for the full grace
+                # disconnected (quiesced() debounce). The boot grace
+                # ends ONLY on ever_connected (latched by the first
+                # EXPERIENCE message): a producer that came and went
+                # inside a compile window is correctly seen (so the
+                # grace doesn't pin the loop), while a param-only
+                # probe — monitoring, or a host that died waiting for
+                # params — must NOT end it (observed live: a 5s probe
+                # flipped saw_remote and the learner self-terminated
+                # 88s into a 300s grace)
                 if hasattr(self.transport, "active_connections"):
-                    if (self.transport.active_connections > 0
-                            or getattr(self.transport, "ever_connected",
-                                       False)):
+                    if getattr(self.transport, "ever_connected", False):
                         saw_remote = True
                     booting = (not saw_remote
                                and self.cfg.actors.num_actors == 0

@@ -490,3 +490,42 @@ def test_param_only_probe_is_not_a_producer():
     finally:
         client.close()
         server.stop()
+
+
+def test_param_probe_does_not_end_learner_boot_grace():
+    """A remote-only learner (0 local actors) must hold its full boot
+    grace even when a param-only client touches the listener: probes
+    polled active_connections into saw_remote and the learner
+    self-terminated 88s into a 300s grace (observed live, round 4)."""
+    from ape_x_dqn_tpu.configs import get_config
+
+    cfg = get_config("cartpole_smoke").replace(
+        actors=ActorConfig(num_actors=0, remote_boot_grace_s=4.0),
+        replay=ReplayConfig(kind="prioritized", capacity=512, min_fill=64),
+        learner=LearnerConfig(batch_size=16, publish_every=20),
+        inference=InferenceConfig(max_batch=4, deadline_ms=1.0),
+        eval_every_steps=0, eval_episodes=0)
+    server = SocketIngestServer("127.0.0.1", 0)
+    driver = ApexDriver(cfg, transport=server)
+    probe = SocketTransport("127.0.0.1", server.port)
+    t_run = {}
+
+    def run():
+        t0 = time.monotonic()
+        driver.run(total_env_frames=10**9, max_grad_steps=10**9,
+                   wall_clock_limit_s=20.0)
+        t_run["wall"] = time.monotonic() - t0
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    # poke the listener with param-only pulls through the grace window
+    for _ in range(6):
+        probe.get_params()
+        time.sleep(0.25)
+    probe.close()
+    th.join(timeout=60)
+    assert not th.is_alive(), "driver.run never returned"
+    # the run must have survived at least the grace (it exits when the
+    # grace lapses with no producer, NOT when the probe disconnects)
+    assert t_run["wall"] >= 3.5, t_run
+    server.stop()
