@@ -245,8 +245,7 @@ def _preset_pong() -> RunConfig:
         # actor fleet's replay — the pathology PERF.md measured live.
         # sample_chunk=4: K-batch sampling relaxation, +4% on the real
         # chip with learning parity on the catch e2e (PERF.md "K-batch
-        # sampling"); the dist learner (atari57 preset) keeps exact
-        # per-step semantics — K-batch is not implemented there yet.
+        # sampling").
         learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3,
                               sample_chunk=4),
         actors=ActorConfig(num_actors=8, envs_per_actor=16),
@@ -264,11 +263,14 @@ def _preset_atari57_apex() -> RunConfig:
         # fits in HBM as single frames (~10KB/transition vs ~56KB flat)
         replay=ReplayConfig(kind="prioritized", capacity=2_000_000,
                             storage="frame_ring"),
-        # replay-ratio pin + vector actors: see the pong preset note.
-        # 256 actor threads x 16 envs = 4096 env slots across the
-        # remote actor hosts; each thread ships one 16-item inference
-        # query per vector step (runtime/vector_actor.py)
-        learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3),
+        # replay-ratio pin + vector actors + K-batch sampling: see the
+        # pong preset notes (the dist learner implements the same
+        # sample_chunk relaxation per shard). 256 actor threads x 16
+        # envs = 4096 env slots across the remote actor hosts; each
+        # thread ships one 16-item inference query per vector step
+        # (runtime/vector_actor.py)
+        learner=LearnerConfig(batch_size=512, steps_per_frame_cap=1.6e-3,
+                              sample_chunk=4),
         actors=ActorConfig(num_actors=256, envs_per_actor=16),
         parallel=ParallelConfig(dp=4, tp=2),
     )

@@ -121,34 +121,36 @@ class _DistLearnerBase:
 
     # -- pure step ---------------------------------------------------------
 
-    def _train_step(self, state: DistTrainState
-                    ) -> tuple[DistTrainState, dict]:
-        keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
-        rng, sk = keys[:, 0], keys[:, 1]
+    def _sample_weighted(self, state: DistTrainState, sk, n_per_shard):
+        """Per-shard stratified sample of n_per_shard items + global IS
+        weights over the [dp, n_per_shard] pool.
 
-        # per-shard stratified sampling from per-shard trees (no ICI).
-        # sample_items delegates storage reconstruction to the replay —
-        # flat layouts gather rows, the frame-ring layout rebuilds stacks
-        # from single frames (replay/frame_ring.py); the size clamp keeps
-        # a sparsely-filled shard's descent off zero-priority leaves
+        sample_items delegates storage reconstruction to the replay —
+        flat layouts gather rows, the frame-ring layout rebuilds stacks
+        from single frames (replay/frame_ring.py); the size clamp keeps
+        a sparsely-filled shard's descent off zero-priority leaves.
+
+        IS weights against the ACTUAL sampling distribution: a draw
+        lands in each shard with probability 1/dp (stratified — every
+        shard contributes exactly n_per_shard draws) and within shard d
+        on item i with probs = p_i/m_d, so P(i) = probs/dp EXACTLY, even
+        with skewed shard masses. At beta=1 the weighted estimate is
+        therefore unbiased toward the uniform target regardless of
+        skew (tests/test_parallel.py::test_skewed_shard_is_weights —
+        weighting by the single-global-tree probability p_i/M instead
+        would bias each shard's contribution by M/(dp*m_d)). What
+        skew DOES perturb is the sampling distribution itself: items
+        in a starved shard are over-sampled (and down-weighted);
+        round-robin ingest keeps masses balanced in expectation, so
+        the effective prioritization tracks the single-tree recipe.
+
+        Returns (items [dp, n, ...], idx [dp, n], w [dp, n]) with w
+        NOT yet max-normalized (callers normalize per training batch).
+        """
         def shard_sample(rstate: ReplayState, key):
-            return self.replay.sample_items(rstate, key, self.b_local)
+            return self.replay.sample_items(rstate, key, n_per_shard)
 
         items, idx, probs = jax.vmap(shard_sample)(state.replay, sk)
-
-        # IS weights against the ACTUAL sampling distribution: a draw
-        # lands in each shard with probability 1/dp (stratified — every
-        # shard contributes exactly b_local draws) and within shard d on
-        # item i with probs = p_i/m_d, so P(i) = probs/dp EXACTLY, even
-        # with skewed shard masses. At beta=1 the weighted estimate is
-        # therefore unbiased toward the uniform target regardless of
-        # skew (tests/test_parallel.py::test_skewed_shard_is_weights —
-        # weighting by the single-global-tree probability p_i/M instead
-        # would bias each shard's contribution by M/(dp*m_d)). What
-        # skew DOES perturb is the sampling distribution itself: items
-        # in a starved shard are over-sampled (and down-weighted);
-        # round-robin ingest keeps masses balanced in expectation, so
-        # the effective prioritization tracks the single-tree recipe.
         n_global = jnp.maximum(
             state.replay.size.astype(jnp.float32).sum(), 1.0)
         w = (n_global * jnp.maximum(probs / self.dp, 1e-12)
@@ -156,36 +158,94 @@ class _DistLearnerBase:
         # dead frame-ring pad slots (prob ~0) would dominate the max-
         # normalization; they train with weight 0 instead
         w = w * jax.vmap(self.replay.valid_mask)(state.replay, idx)
+        return items, idx, w
+
+    def _flat(self, x):
+        y = x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
+        return jax.lax.with_sharding_constraint(y, self._dp_sharding)
+
+    def _sgd_step(self, params, target_params, opt_state, step,
+                  items, w):
+        """One loss/grad/optimizer/target-sync update on an
+        already-sampled [dp, b_local] batch (shared by the exact
+        per-step path and the K-batch relaxation). `w` is the raw IS
+        weight ([dp, b_local]); max-normalization happens here so each
+        training batch is normalized over exactly its own draws."""
         w = w / jnp.maximum(w.max(), 1e-12)
-
-        def flat(x):
-            y = x.reshape(x.shape[0] * x.shape[1], *x.shape[2:])
-            return jax.lax.with_sharding_constraint(
-                y, self._dp_sharding)
-
-        batch = self._make_batch(jax.tree.map(flat, items))
+        batch = self._make_batch(jax.tree.map(self._flat, items))
         (loss, aux), grads = jax.value_and_grad(
             self.loss_fn, has_aux=True)(
-            state.params, state.target_params, batch, flat(w))
+            params, target_params, batch, self._flat(w))
         updates, opt_state = self.optimizer.update(
-            grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-
-        # per-shard priority write-back
-        td_shard = aux["td_abs"].reshape(self.dp, self.b_local)
-        new_replay = jax.vmap(
-            lambda rs, i, td: self.replay.update_priorities(rs, i, td)
-        )(state.replay, idx, td_shard)
-
-        step = state.step + 1
+            grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        step = step + 1
         sync = (step % self.lcfg.target_sync_every == 0)
         target_params = jax.tree.map(
-            lambda t, p: jnp.where(sync, p, t), state.target_params, params)
+            lambda t, p: jnp.where(sync, p, t), target_params, params)
+        td_shard = aux["td_abs"].reshape(self.dp, self.b_local)
         metrics = {"loss": loss, "q_mean": aux["q_mean"],
                    "td_abs_mean": aux["td_abs"].mean(),
                    "grad_norm": optax.global_norm(grads)}
+        return params, target_params, opt_state, step, td_shard, metrics
+
+    def _train_step(self, state: DistTrainState
+                    ) -> tuple[DistTrainState, dict]:
+        keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
+        rng, sk = keys[:, 0], keys[:, 1]
+        items, idx, w = self._sample_weighted(state, sk, self.b_local)
+        params, target_params, opt_state, step, td_shard, metrics = \
+            self._sgd_step(state.params, state.target_params,
+                           state.opt_state, state.step, items, w)
+        # per-shard priority write-back
+        new_replay = jax.vmap(
+            lambda rs, i, td: self.replay.update_priorities(rs, i, td)
+        )(state.replay, idx, td_shard)
         return DistTrainState(params, target_params, opt_state, new_replay,
                               rng, step), metrics
+
+    def _train_step_k(self, state: DistTrainState,
+                      k: int) -> tuple[DistTrainState, dict]:
+        """K grad-steps from ONE per-shard stratified sample + ONE
+        priority write-back — the K-batch relaxation
+        (LearnerConfig.sample_chunk), dist form of
+        runtime/learner.py:DQNLearner._train_step_k; same staleness
+        semantics, same interleaved-strata chunking (chunk j takes
+        strata {j, j+K, ...} within every shard so each chunk spans
+        the full per-shard priority range), same static unrolled loop
+        (lax.scan conv bodies are pathologically slow on CPU)."""
+        keys = jax.vmap(lambda kk: jax.random.split(kk, 2))(state.rng)
+        rng, sk = keys[:, 0], keys[:, 1]
+        items, idx, w = self._sample_weighted(state, sk,
+                                              k * self.b_local)
+
+        def chunked(x):
+            # [dp, b_local*k, ...] -> [k, dp, b_local, ...] with chunk
+            # j = strata {j, j+k, ...} (stratum s = i*k + j at [j, :, i])
+            y = x.reshape(x.shape[0], self.b_local, k, *x.shape[2:])
+            return jnp.moveaxis(y, 2, 0)
+
+        items_k = jax.tree.map(chunked, items)
+        w_k = chunked(w)
+        params, target_params, opt_state, step = (
+            state.params, state.target_params, state.opt_state,
+            state.step)
+        td_parts = []
+        metrics = None
+        for j in range(k):
+            it = jax.tree.map(lambda x: x[j], items_k)
+            params, target_params, opt_state, step, td_shard, metrics = \
+                self._sgd_step(params, target_params, opt_state, step,
+                               it, w_k[j])
+            td_parts.append(td_shard)
+        # invert the chunk transform: td_all[d, i*k + j] = parts[j][d, i]
+        td_all = jnp.moveaxis(jnp.stack(td_parts, axis=0), 0, 2) \
+            .reshape(self.dp, k * self.b_local)
+        new_replay = jax.vmap(
+            lambda rs, i, td: self.replay.update_priorities(rs, i, td)
+        )(state.replay, idx, td_all)
+        return DistTrainState(params, target_params, opt_state,
+                              new_replay, rng, step), metrics
 
     # -- jitted endpoints --------------------------------------------------
 
@@ -194,11 +254,35 @@ class _DistLearnerBase:
         return self._train_step(state)
 
     @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
+    def train_step_k(self, state: DistTrainState, k: int):
+        """Scan-free K-batch macro-step (see DQNLearner.train_step_k)."""
+        return self._train_step_k(state, k)
+
+    @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
     def train_many(self, state: DistTrainState, n: int):
+        """n grad-steps per dispatch; with sample_chunk=K>1 runs n//K
+        K-batch macro-steps plus exact singles for any remainder."""
+        k = getattr(self.lcfg, "sample_chunk", 1)
+
         def body(s, _):
             s, m = self._train_step(s)
             return s, m
-        state, metrics = jax.lax.scan(body, state, None, length=n)
+
+        if k <= 1:
+            state, metrics = jax.lax.scan(body, state, None, length=n)
+            return state, jax.tree.map(lambda x: x[-1], metrics)
+
+        def body_k(s, _):
+            s, m = self._train_step_k(s, k)
+            return s, m
+
+        metrics = None
+        if n // k:
+            state, metrics = jax.lax.scan(body_k, state, None,
+                                          length=n // k)
+        if n % k:
+            state, rem = jax.lax.scan(body, state, None, length=n % k)
+            return state, jax.tree.map(lambda x: x[-1], rem)
         return state, jax.tree.map(lambda x: x[-1], metrics)
 
     @partial(jax.jit, static_argnums=0, donate_argnums=1)
