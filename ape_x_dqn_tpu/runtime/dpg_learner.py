@@ -52,6 +52,13 @@ class DPGLearner:
 
     def __init__(self, actor_apply: Callable, critic_apply: Callable,
                  replay, lcfg):
+        if getattr(lcfg, "sample_chunk", 1) > 1:
+            # loud, not silent: the K-batch relaxation is implemented
+            # for the flat-transition DQN learners only (see
+            # runtime/sequence_learner.py for the same gate)
+            raise ValueError(
+                "learner.sample_chunk > 1 is not implemented by the "
+                "DPG learner — set sample_chunk=1")
         self.actor_apply = actor_apply
         self.critic_apply = critic_apply
         self.replay = replay

@@ -34,6 +34,17 @@ class SequenceLearner:
     def __init__(self, net_apply_seq: Callable, replay, lcfg, rcfg,
                  optimizer: optax.GradientTransformation | None = None):
         """net_apply_seq(params, obs[B,T,...], (c,h)) -> (q[B,T,A], state)."""
+        if getattr(lcfg, "sample_chunk", 1) > 1:
+            # fail loudly instead of silently training exact: the
+            # K-batch relaxation is implemented for the flat-transition
+            # learners (runtime/learner.py) and the dist learners
+            # (parallel/dist_learner.py); sequence-replay learning
+            # parity for it is unvalidated, so this learner does not
+            # accept the config
+            raise ValueError(
+                "learner.sample_chunk > 1 is not implemented by the "
+                "single-chip SequenceLearner — set sample_chunk=1 "
+                "(the r2d2 preset default)")
         self.net_apply_seq = net_apply_seq
         self.replay = replay
         self.lcfg = lcfg

@@ -92,3 +92,30 @@ def test_hns():
     assert len(ATARI_HUMAN_RANDOM) == 57
     assert abs(human_normalized_score("pong", 14.6) - 1.0) < 1e-9
     assert abs(median_hns({"pong": 14.6, "breakout": 30.5}) - 1.0) < 1e-9
+
+
+def test_sample_chunk_gated_for_unimplemented_families():
+    """Families without the K-batch relaxation must reject
+    sample_chunk>1 loudly, not silently train exact semantics under a
+    config that claims otherwise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from ape_x_dqn_tpu.configs import LearnerConfig, ReplayConfig
+    from ape_x_dqn_tpu.models import DPGActor, DPGCritic
+    from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+    from ape_x_dqn_tpu.runtime.dpg_learner import DPGLearner
+    from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
+
+    lcfg = LearnerConfig(batch_size=8, sample_chunk=4)
+    with pytest.raises(ValueError, match="sample_chunk"):
+        SequenceLearner(lambda p, o, s: (o, s),
+                        PrioritizedReplay(capacity=64), lcfg,
+                        ReplayConfig(kind="sequence"))
+    actor = DPGActor(action_dim=1, action_low=-1, action_high=1)
+    critic = DPGCritic()
+    with pytest.raises(ValueError, match="sample_chunk"):
+        DPGLearner(actor.apply, critic.apply,
+                   PrioritizedReplay(capacity=64), lcfg)
