@@ -178,6 +178,13 @@ class RunConfig:
     eval_every_steps: int = 10_000
     eval_episodes: int = 10
     eval_eps: float = 0.001
+    # Per-episode frame cap for the periodic/final eval. The Atari
+    # protocol's 108k (30 min of game time) is right for real ALE runs;
+    # hosts where each eval env-step is expensive (e.g. queries
+    # crossing a slow host<->device link) can bound it — an uncapped
+    # episode left the rotation unable to finish a single eval while
+    # training saturated the device (PERF.md "Live multi-game").
+    eval_max_frames: int = 108_000
     checkpoint_dir: str = ""
     checkpoint_every: int = 50_000
     # Opt-in, SINGLE-HOST driver only (the multihost driver rejects it:

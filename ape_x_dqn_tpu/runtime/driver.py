@@ -636,7 +636,8 @@ class ApexDriver:
                 t_eval = time.monotonic()
                 res, depth_max = run_eval_measured(
                     worker, self.cfg.eval_episodes, self.server,
-                    stop_event=self.stop_event)
+                    stop_event=self.stop_event,
+                    max_frames=self.cfg.eval_max_frames)
                 if res is None:  # cancelled mid-eval at shutdown
                     break
                 with self._lock:
@@ -783,7 +784,9 @@ class ApexDriver:
                         final_eval_game)
                     game = final_eval_game(self.cfg)
                     res = self._make_eval_worker(game=game).run(
-                        self.cfg.eval_episodes, deadline_s=60.0)
+                        self.cfg.eval_episodes,
+                        max_frames=self.cfg.eval_max_frames,
+                        deadline_s=60.0)
                     if res is not None:
                         self.last_eval = res
                         self.metrics.log(self._grad_steps_total,

@@ -118,7 +118,8 @@ class EvalWorker:
 
 def run_eval_measured(worker: "EvalWorker", episodes: int, server,
                       stop_event=None,
-                      deadline_s: float | None = None
+                      deadline_s: float | None = None,
+                      max_frames: int = 108_000
                       ) -> tuple[dict | None, int]:
     """Run worker.run while polling the shared inference server's
     queue depth at ~20Hz; returns (result, max depth seen DURING the
@@ -138,8 +139,8 @@ def run_eval_measured(worker: "EvalWorker", episodes: int, server,
     t = threading.Thread(target=poll, name="eval-depth-poll", daemon=True)
     t.start()
     try:
-        res = worker.run(episodes, stop_event=stop_event,
-                         deadline_s=deadline_s)
+        res = worker.run(episodes, max_frames=max_frames,
+                         stop_event=stop_event, deadline_s=deadline_s)
     finally:
         done.set()
         t.join(timeout=1.0)

@@ -476,7 +476,8 @@ class MultihostApexDriver:
                 t_eval = time.monotonic()
                 res, depth_max = run_eval_measured(
                     worker, self.cfg.eval_episodes, self.server,
-                    stop_event=self.stop_event)
+                    stop_event=self.stop_event,
+                    max_frames=self.cfg.eval_max_frames)
                 if res is None:  # cancelled mid-eval at shutdown
                     break
                 with self._lock:
@@ -814,7 +815,9 @@ class MultihostApexDriver:
                     final_eval_game)
                 game = final_eval_game(cfg)
                 res = self._make_eval_worker(game=game).run(
-                    cfg.eval_episodes, deadline_s=60.0)
+                    cfg.eval_episodes,
+                    max_frames=cfg.eval_max_frames,
+                    deadline_s=60.0)
                 if res is not None:
                     self.last_eval = res
                     self.metrics.log(

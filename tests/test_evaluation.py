@@ -136,7 +136,8 @@ def test_run_eval_measured_samples_depth_during_eval():
         def __init__(self, server):
             self.server = server
 
-        def run(self, episodes, stop_event=None, deadline_s=None):
+        def run(self, episodes, max_frames=108_000, stop_event=None,
+                deadline_s=None):
             self.server.queue_depth = 7  # pressure while eval runs
             time.sleep(0.3)
             self.server.queue_depth = 0  # drained the instant it ends
@@ -168,3 +169,24 @@ def test_rolling_suite_score_backend_marking():
     out = roll.update("pong", -21.0)
     assert out["eval_games_seen"] == 2
     assert roll.scores["pong"] == -21.0
+
+
+def test_eval_max_frames_caps_episode_length():
+    """cfg.eval_max_frames bounds each eval episode: a policy that
+    never terminates must return after exactly that many frames (an
+    uncapped 108k-frame episode left slow-link hosts unable to finish
+    a single eval — PERF.md 'Live multi-game')."""
+    cfg = get_config("pong").replace(
+        env=EnvConfig(id="pong", kind="synthetic_atari"),
+        eval_max_frames=50)
+
+    steps = {"n": 0}
+
+    def query_fn(obs):
+        steps["n"] += 1
+        return np.zeros(6, np.float32)
+
+    worker = EvalWorker(cfg, query_fn)
+    res = worker.run(1, max_frames=cfg.eval_max_frames)
+    assert res is not None and res["episodes"] == 1
+    assert steps["n"] <= cfg.eval_max_frames
