@@ -19,6 +19,15 @@ Semantics match LoopbackTransport: ingest is lossy-tolerant (bounded
 queue, drop-oldest under backpressure; a dead learner connection drops
 batches rather than killing the actor), so actor loss / learner restart
 degrade gracefully (SURVEY.md §5 failure detection).
+
+WIRE-FORMAT COMPATIBILITY: the round-4 bf16 param wire is a pickle-level
+break — param blobs now carry _Bf16Wire marker objects, which a PRE-bf16
+actor-host build cannot unpickle (its get_params fails the load and the
+actor silently stays on stale params; only builds at/after the change
+log the skew warning). Mixed-build fleets must either upgrade actor
+hosts first or run the learner with --param-wire-dtype float32, whose
+blobs remain loadable by every build. Same-build fleets (the supported
+deployment) are unaffected.
 """
 
 from __future__ import annotations
@@ -311,13 +320,18 @@ class SocketIngestServer:
                     # gone during construction, so boot grace was
                     # skipped and quiesced() read idle (observed in the
                     # round-4 soak)
+                    # byte counters under the lock too: every reader
+                    # thread increments them, and a bare `+=` interleaved
+                    # across threads loses counts — they are the soak's
+                    # link-budget accounting, so they must be exact
                     with self._conns_lock:
                         self._ever_connected = True
-                    self._bytes_in += len(payload)
+                        self._bytes_in += len(payload)
                     self.send_experience(decode_batch(payload))
                 elif mtype == MSG_PARAMS_REQ:
                     blob = self._param_blob()
-                    self._bytes_out += len(blob)
+                    with self._conns_lock:
+                        self._bytes_out += len(blob)
                     _send_msg(conn, MSG_PARAMS, blob)
         except (OSError, ValueError):
             return  # dead/corrupt connection: drop it, keep serving others

@@ -276,13 +276,16 @@ class _DistLearnerBase:
             s, m = self._train_step_k(s, k)
             return s, m
 
+        # remainder singles FIRST: the returned last-step metrics then
+        # come from the K-batch macro-steps that did the bulk of the
+        # work (see DQNLearner.train_many)
         metrics = None
+        if n % k:
+            state, metrics = jax.lax.scan(body, state, None,
+                                          length=n % k)
         if n // k:
             state, metrics = jax.lax.scan(body_k, state, None,
                                           length=n // k)
-        if n % k:
-            state, rem = jax.lax.scan(body, state, None, length=n % k)
-            return state, jax.tree.map(lambda x: x[-1], rem)
         return state, jax.tree.map(lambda x: x[-1], metrics)
 
     @partial(jax.jit, static_argnums=0, donate_argnums=1)

@@ -45,7 +45,8 @@ from ape_x_dqn_tpu.runtime.learner import DQNLearner
 from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 from ape_x_dqn_tpu.runtime.single_process import build_replay
 from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
-from ape_x_dqn_tpu.utils.metrics import Metrics, Throughput
+from ape_x_dqn_tpu.utils.metrics import (
+    Metrics, Throughput, log_run_header)
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
 
@@ -671,6 +672,9 @@ class ApexDriver:
             wall_clock_limit_s: float | None = None) -> dict:
         total = total_env_frames or self.cfg.total_env_frames
         per_actor = total // max(self.cfg.actors.num_actors, 1)
+        # self-describing JSONL: sampling semantics + storage layout
+        # ride the stream itself (utils/metrics.log_run_header)
+        log_run_header(self.metrics, self.cfg, self._grad_steps_total)
         try:
             self._warmup()
         except (AttributeError, NotImplementedError) as e:

@@ -66,7 +66,7 @@ from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, family_setup, server_apply_fn,
     warmup_example)
 from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
-from ape_x_dqn_tpu.utils.metrics import Metrics
+from ape_x_dqn_tpu.utils.metrics import Metrics, log_run_header
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
 
@@ -163,13 +163,24 @@ class MultihostApexDriver:
         # a 1-process fleet is valid ONLY under an initialized
         # jax.distributed runtime (the CLI's --coordinator path; the
         # driver artifact certifies the round protocol that way) —
-        # plain single-process training belongs in ApexDriver
-        dist_on = False
+        # plain single-process training belongs in ApexDriver.
+        # jax.distributed.is_initialized is the public signal (jax
+        # >= 0.4.34); the private global_state probe is only a
+        # fallback for older jax, and falling back is logged so a
+        # silent False can't mask valid --coordinator runs after a
+        # jax upgrade moves the private symbol (round-4 advisor)
         try:
-            from jax._src import distributed as _dist
-            dist_on = _dist.global_state.client is not None
-        except Exception:  # noqa: BLE001 - internal-API probe only
-            dist_on = False
+            dist_on = bool(jax.distributed.is_initialized())
+        except AttributeError:
+            import logging
+            logging.getLogger(__name__).warning(
+                "jax.distributed.is_initialized unavailable on this "
+                "jax version — probing the private global_state API")
+            try:
+                from jax._src import distributed as _dist
+                dist_on = _dist.global_state.client is not None
+            except Exception:  # noqa: BLE001 - internal-API probe only
+                dist_on = False
         assert jax.process_count() > 1 or dist_on, \
             "MultihostApexDriver requires jax.distributed (use ApexDriver " \
             "for single-process runs)"
@@ -597,6 +608,9 @@ class MultihostApexDriver:
                                     name=f"actor-{i}", daemon=True)
                    for i in range(cfg.actors.num_actors)]
         self._actor_threads = threads  # _pump_ingest's cap-lift check
+        # self-describing JSONL: sampling semantics + storage layout
+        # ride the stream itself (utils/metrics.log_run_header)
+        log_run_header(self.metrics, cfg, self._grad_steps)
         try:
             self._warmup(chunk_steps)
         except (AttributeError, NotImplementedError) as e:

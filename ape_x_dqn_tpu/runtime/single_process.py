@@ -26,7 +26,7 @@ from ape_x_dqn_tpu.replay.prioritized import (
     PrioritizedReplay, UniformReplayDevice)
 from ape_x_dqn_tpu.runtime.learner import (
     DQNLearner, transition_item_spec)
-from ape_x_dqn_tpu.utils.metrics import Metrics
+from ape_x_dqn_tpu.utils.metrics import Metrics, log_run_header
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import RngStream, component_key
 
@@ -47,6 +47,7 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
     """Run config-1-style training; returns summary stats."""
     total = total_env_frames or cfg.total_env_frames
     metrics = metrics or Metrics()
+    log_run_header(metrics, cfg)
     env = make_env(cfg.env, seed=cfg.seed)
     net = build_network(cfg.network, env.spec)
 
@@ -117,27 +118,31 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
         if (int(state.replay.size) + len(pending) >= cfg.replay.min_fill
                 and frames % train_every == 0):
             flush()
-            done = grad_steps
+            prev_grad_steps = grad_steps
+            m = None
             if sample_chunk > 1:
+                # bank K training opportunities, then one K-batch
+                # macro-dispatch (<=K-1 banked opportunities evaporate
+                # at loop end — same grad/frame ratio, harmless)
                 train_bank += 1
-                if train_bank < sample_chunk:
-                    continue
-                train_bank = 0
-                state, m = learner.train_step_k(state, sample_chunk)
-                grad_steps += sample_chunk
+                if train_bank >= sample_chunk:
+                    train_bank = 0
+                    state, m = learner.train_step_k(state, sample_chunk)
+                    grad_steps += sample_chunk
             else:
                 state, m = learner.train_step(state)
                 grad_steps += 1
-            losses.append(float(m["loss"]))
-            # boundary CROSSING, not equality: K-sized increments would
-            # otherwise only hit exact multiples at lcm(K, 500)
-            if done // 500 != grad_steps // 500:
-                metrics.log(grad_steps, frames=frames,
-                            loss=float(m["loss"]),
-                            q_mean=float(m["q_mean"]),
-                            avg_return=(float(np.mean(returns))
-                                        if returns else 0.0),
-                            eps=eps)
+            if m is not None:
+                losses.append(float(m["loss"]))
+                # boundary CROSSING, not equality: K-sized increments
+                # would otherwise only hit exact multiples at lcm(K, 500)
+                if prev_grad_steps // 500 != grad_steps // 500:
+                    metrics.log(grad_steps, frames=frames,
+                                loss=float(m["loss"]),
+                                q_mean=float(m["q_mean"]),
+                                avg_return=(float(np.mean(returns))
+                                            if returns else 0.0),
+                                eps=eps)
         if (solve_return is not None and len(returns) >= 20
                 and np.mean(list(returns)[-20:]) >= solve_return):
             break

