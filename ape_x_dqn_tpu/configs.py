@@ -242,6 +242,12 @@ def _preset_pong() -> RunConfig:
         total_env_frames=10_000_000,
         env=EnvConfig(id="PongNoFrameskip-v4", kind="atari"),
         network=NetworkConfig(kind="nature_cnn", dueling=True),
+        # 1M transitions rounds to 2^20 in the drivers; as packed
+        # frame-ring byte rows that is 9.63GiB + model/opt + ~2GiB
+        # transient headroom = ~11.7GiB on one 16GiB chip (verified by
+        # compiled memory stats AND a full-capacity bench run — PERF.md
+        # "HBM budget"; the driver's check_hbm_fits re-prices it at
+        # startup)
         replay=ReplayConfig(kind="prioritized", capacity=1_000_000,
                             min_fill=20_000, storage="frame_ring"),
         # steps_per_frame_cap pins the Ape-X effective replay ratio
@@ -290,10 +296,16 @@ def _preset_r2d2() -> RunConfig:
         total_env_frames=10_000_000_000,
         env=EnvConfig(id="atari57", kind="atari"),
         network=NetworkConfig(kind="lstm_q", dueling=True),
-        # frame_ring: sequences store single frames (~0.6MB each at
-        # L=80) instead of per-step stacks (~2.2MB) — the difference
-        # between this capacity fitting across the dp shards or not
-        replay=ReplayConfig(kind="sequence", capacity=100_000,  # sequences
+        # frame_ring: sequences store single frames (~0.56MB packed
+        # byte-rows each at L=80) instead of per-step stacks (~2.2MB).
+        # Capacity is HBM-budgeted (utils/hbm.py): 65536 sequences over
+        # dp=4 shards = 16384/shard x 0.59MB = ~9.0GiB per 16GiB chip
+        # (~2.6M transitions fleet-wide at overlap 40 — above the
+        # attested ~2M-transition replay scale). R2D2-paper 100k+
+        # sequences: raise dp to 8 (--set parallel.dp=8) or run
+        # 32GiB-HBM chips; the driver's check_hbm_fits prints the
+        # budget table if a layout doesn't fit.
+        replay=ReplayConfig(kind="sequence", capacity=65_536,  # sequences
                             seq_length=80, seq_overlap=40, burn_in=40,
                             min_fill=5_000, storage="frame_ring"),
         learner=LearnerConfig(batch_size=64, n_step=5, value_rescale=True,

@@ -291,14 +291,21 @@ class _DistLearnerBase:
     @partial(jax.jit, static_argnums=0, donate_argnums=1)
     def add(self, state: DistTrainState, items: Any,
             td_abs: jax.Array) -> DistTrainState:
-        """items: pytree of [dp, B, ...]; td_abs: [dp, B]."""
+        """items: pytree of [dp, B, ...]; td_abs: [dp, B].
+
+        add_lockstep, NOT jax.vmap(add): vmap batches the in-place
+        dynamic_update_slice ring write into a lax.scatter, which
+        materializes a full shard-storage copy per add (the exact HLO
+        temp the byte-row layout eliminated — replay/packing.py). The
+        lockstep form exploits the dist ingest contract (equal [dp, B]
+        blocks every add -> equal shard cursors) to write all shards
+        with one in-place multi-axis DUS.
+        """
         items = jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(
                 jnp.asarray(x), self._dp_sharding), items)
-        new_replay = jax.vmap(
-            lambda rs, it, td: self.replay.add(rs, it, td)
-        )(state.replay, items, td_abs)
-        return state._replace(replay=new_replay)
+        return state._replace(
+            replay=self.replay.add_lockstep(state.replay, items, td_abs))
 
     # -- weight publication (learner -> inference server over ICI) --------
 

@@ -33,12 +33,18 @@ static under jit:
   of the flat layout's bytes (~6-7x less for B=16..64).
 
 Device state reuses ReplayState: storage holds a frames ring
-[S*F, H, W] uint8 (S = capacity/B segments) plus per-transition fields
-[capacity] (action/reward/discount/next_off); `pos` counts SEGMENTS;
-the sum-tree indexes transitions. Segment k owns transition slots
-[k*B, (k+1)*B) and frame slots [k*F, (k+1)*F): eviction overwrites a
-whole segment at a time, so transition<->frame aliasing is impossible
-by construction.
+[S*F, pad128(H*W)] uint8 byte rows (S = capacity/B segments) plus
+per-transition fields [capacity] (action/reward/discount/next_off);
+`pos` counts SEGMENTS; the sum-tree indexes transitions. Segment k owns
+transition slots [k*B, (k+1)*B) and frame rows [k*F, (k+1)*F): eviction
+overwrites a whole segment at a time, so transition<->frame aliasing is
+impossible by construction.
+
+Frames are BYTE ROWS, not [H, W] planes, and adds are contiguous
+dynamic_update_slice blocks with skip-to-head wrap — the two rules that
+keep the ring resident in HBM at its logical size with zero-copy
+add/sample graphs (see replay/packing.py for the measured OOM story a
+plane layout + scatter produce at flagship capacity).
 
 Dead padding slots carry tree priority 0 and are never sampled (the
 descent clamp in ops/sum_tree.py keeps float rounding off them); their
@@ -57,6 +63,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.ops import sum_tree
+from ape_x_dqn_tpu.replay.packing import (dus_rows, pad128,
+                                          ring_write_size,
+                                          ring_write_start)
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
 
 
@@ -193,7 +202,11 @@ class FrameRingReplay(PrioritizedReplay):
         self.h, self.w, self.stack = obs_shape
         self.F = self.B + self.n + self.stack - 1
         self.S = capacity // self.B          # segment slots
+        self.frame_bytes = self.h * self.w
+        self.frame_row = pad128(self.frame_bytes)
         self.obs_dtype = obs_dtype
+        assert np.dtype(obs_dtype) == np.uint8, \
+            "frame-ring byte-row storage assumes uint8 frames"
 
     # -- state construction ------------------------------------------------
 
@@ -201,7 +214,7 @@ class FrameRingReplay(PrioritizedReplay):
         """item_spec is accepted for interface parity and ignored — the
         storage layout is fixed by the constructor arguments."""
         storage = {
-            "frames": jnp.zeros((self.S * self.F, self.h, self.w),
+            "frames": jnp.zeros((self.S * self.F, self.frame_row),
                                 self.obs_dtype),
             "action": jnp.zeros((self.capacity,), jnp.int32),
             "reward": jnp.zeros((self.capacity,), jnp.float32),
@@ -213,52 +226,93 @@ class FrameRingReplay(PrioritizedReplay):
 
     # -- transitions (pure, jit-friendly) ----------------------------------
 
+    def _write_segments(self, state: ReplayState, items: Any,
+                        td_abs: jax.Array,
+                        lead: tuple[int, ...]) -> ReplayState:
+        """Shared body of `add` (lead=()) and `add_lockstep`
+        (lead=(dp,)): ONE contiguous dynamic_update_slice block of
+        G*F frame rows / G*B transition slots per leading shard axis
+        (in place on the donated state; a vmapped DUS would rebatch to
+        a full-copy scatter — replay/packing.py), with skip-to-head
+        wrap at the segment cursor."""
+        nl = len(lead)
+        g = td_abs.shape[nl]
+        pos0 = state.pos if nl == 0 else state.pos[0]
+        size0 = state.size if nl == 0 else state.size[0]
+        seg0 = ring_write_start(pos0, g, self.S)
+        tidx = seg0 * self.B + jnp.arange(g * self.B, dtype=jnp.int32)
+        rows = items["seg_frames"].astype(self.obs_dtype) \
+            .reshape(*lead, g * self.F, self.frame_bytes)
+        if self.frame_row != self.frame_bytes:
+            rows = jnp.pad(rows, [(0, 0)] * (nl + 1)
+                           + [(0, self.frame_row - self.frame_bytes)])
+        storage = dict(state.storage)
+        storage["frames"] = dus_rows(state.storage["frames"], rows,
+                                     seg0 * self.F, lead=nl)
+        for k in ("action", "reward", "discount", "next_off"):
+            storage[k] = dus_rows(state.storage[k],
+                                  items[k].reshape(*lead, g * self.B),
+                                  seg0 * self.B, lead=nl)
+        valid = items["next_off"].reshape(*lead, g * self.B) > 0
+        pri = jnp.where(
+            valid,
+            (td_abs.reshape(*lead, g * self.B) + self.eps) ** self.alpha,
+            0.0)
+        pos1 = (seg0 + g) % self.S
+        size1 = ring_write_size(size0, seg0 * self.B, g * self.B,
+                                self.capacity)
+        if nl == 0:
+            tree = sum_tree.update(state.tree, tidx, pri)
+            return ReplayState(storage=storage, tree=tree,
+                               pos=pos1, size=size1)
+        tree = jax.vmap(sum_tree.update, in_axes=(0, None, 0))(
+            state.tree, tidx, pri)
+        return ReplayState(
+            storage=storage, tree=tree,
+            pos=jnp.full(lead, pos1, jnp.int32),
+            size=jnp.full(lead, size1, jnp.int32))
+
     def add(self, state: ReplayState, items: Any,
             td_abs: jax.Array) -> ReplayState:
         """Write G whole segments at the segment cursor.
 
         items: {"seg_frames": [G, F, H, W], "action"/"reward"/"discount"/
         "next_off": [G, B]}; td_abs: [G, B] initial |TD| (0 on dead pads).
+        In-place block write with skip-to-head wrap (_write_segments).
         """
-        g = td_abs.shape[0]
-        seg = (state.pos + jnp.arange(g, dtype=jnp.int32)) % self.S
-        fidx = (seg[:, None] * self.F
-                + jnp.arange(self.F, dtype=jnp.int32)[None, :]).reshape(-1)
-        tidx = (seg[:, None] * self.B
-                + jnp.arange(self.B, dtype=jnp.int32)[None, :]).reshape(-1)
-        storage = dict(state.storage)
-        storage["frames"] = state.storage["frames"].at[fidx].set(
-            items["seg_frames"].reshape(g * self.F, self.h, self.w)
-            .astype(self.obs_dtype))
-        for k in ("action", "reward", "discount", "next_off"):
-            buf = state.storage[k]
-            storage[k] = buf.at[tidx].set(
-                items[k].reshape(-1).astype(buf.dtype))
-        valid = items["next_off"].reshape(-1) > 0
-        pri = jnp.where(valid, (td_abs.reshape(-1) + self.eps) ** self.alpha,
-                        0.0)
-        tree = sum_tree.update(state.tree, tidx, pri)
-        return ReplayState(
-            storage=storage, tree=tree,
-            pos=(state.pos + g) % self.S,
-            size=jnp.minimum(state.size + g * self.B, self.capacity))
+        return self._write_segments(state, items, td_abs, lead=())
+
+    def add_lockstep(self, state: ReplayState, items: Any,
+                     td_abs: jax.Array) -> ReplayState:
+        """Segment add for [dp, ...]-stacked lockstep shard states —
+        see PrioritizedReplay.add_lockstep for the lockstep-cursor
+        contract. items: {"seg_frames": [dp, G, F, H, W], fields:
+        [dp, G, B]}; td_abs: [dp, G, B]."""
+        return self._write_segments(state, items, td_abs,
+                                    lead=(td_abs.shape[0],))
 
     def _gather(self, state: ReplayState, idx: jax.Array) -> dict:
         """Reconstruct flat transitions {obs, action, reward, next_obs,
-        discount} for transition indices idx [Bt] — the stack gather."""
+        discount} for transition indices idx [Bt] — a row gather of
+        stack frames per side, then a batch-local reshape to [H, W]
+        planes (the ring itself is never relaid out)."""
         st = state.storage
         seg, j = idx // self.B, idx % self.B
         base = seg * self.F + j
         offs = jnp.arange(self.stack, dtype=jnp.int32)[None, :]
-        obs_f = st["frames"][base[:, None] + offs]          # [Bt,stack,H,W]
-        nbase = base + st["next_off"][idx]                  # dead: off 0 —
-        next_f = st["frames"][nbase[:, None] + offs]        # never sampled
-        to_hwc = lambda f: jnp.moveaxis(f, 1, -1)           # -> [Bt,H,W,st]
+
+        def stack_at(rows_base):
+            f = st["frames"][rows_base[:, None] + offs]  # [Bt,stack,row]
+            f = f[..., :self.frame_bytes].reshape(
+                -1, self.stack, self.h, self.w)
+            return jnp.moveaxis(f, 1, -1)                # -> [Bt,H,W,st]
+
         return {
-            "obs": to_hwc(obs_f),
+            "obs": stack_at(base),
             "action": st["action"][idx],
             "reward": st["reward"][idx],
-            "next_obs": to_hwc(next_f),
+            # dead slots: next_off 0 — never sampled
+            "next_obs": stack_at(base + st["next_off"][idx]),
             "discount": st["discount"][idx],
         }
 

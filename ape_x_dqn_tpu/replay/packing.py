@@ -1,0 +1,194 @@
+"""Tile-exact pixel packing + in-place ring writes for HBM replay.
+
+Why this module exists (round-5 HBM story, PERF.md "HBM budget"):
+
+TPU HBM arrays are stored in (8, 128)-tiled layouts — for uint8 the
+effective tile is (32, 128) over the two minor dimensions. A pixel
+buffer shaped [capacity, 84, 84] therefore pads 84 -> (88, 128) and
+occupies **1.6x** its logical bytes. Worse, XLA then assigns the
+*parameter* a compact (unpadded) layout to save that memory and inserts
+a full-buffer relayout copy inside every program that gathers from or
+scatters into it: measured on the v5e chip, the pong preset's 9.47GB
+frame ring compiled to a 15.12GB HLO temp copy inside `add` (25.1GB
+total — OOM on a 15.75GB chip).
+
+Two design rules eliminate both costs:
+
+1. **Pack pixel leaves into exactly-tiled byte rows.** Store
+   [capacity, pad128(prod(frame_dims))] uint8 — the minor dim a
+   multiple of 128 and the major dim a multiple of 32 makes the padded
+   tiled layout bit-identical to the compact layout, so no relayout
+   copy can exist anywhere, and the storage overhead is the row
+   padding alone (<=1.6%, e.g. 7056 -> 7168 bytes for an 84x84 frame).
+   Unpacking after a sample's row gather touches only the sampled
+   batch (MBs, not GBs).
+
+2. **Ring writes are `dynamic_update_slice`, never scatter.** A
+   scatter into a large donated buffer still materializes a full copy
+   (measured: 19.1GB for the 9.47GB 2-D ring); a dynamic_update_slice
+   on a donated argument aliases in place (measured: temp=0). Since a
+   replay add always writes a contiguous index block, the only case
+   DUS cannot express is a block wrapping the ring boundary — handled
+   by SKIP-TO-HEAD semantics: a block that would wrap is written at
+   slot 0 instead, leaving the few tail slots holding their previous
+   (still-consistent) items. When the block size divides the capacity
+   — every shipping ingest path; block sizes are fixed per staging
+   buffer — the wrap case never occurs and semantics are bit-identical
+   to the modular ring.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Minor-dim tile width (lanes) shared by every TPU dtype; uint8 arrays
+# additionally want the second-minor dim a multiple of 32 (8 sublanes x
+# 4-byte packing) for the padded layout to equal the compact one.
+LANE = 128
+U8_SUBLANE = 32
+
+
+def pad128(n: int) -> int:
+    """Round up to the 128-byte lane tile."""
+    return -(-int(n) // LANE) * LANE
+
+
+def ring_write_start(pos: jax.Array, block: int, capacity: int) -> jax.Array:
+    """Start slot for an in-place contiguous ring write (skip-to-head).
+
+    pos is the ring cursor; a `block`-slot write that would cross the
+    ring boundary restarts at 0 (see module docstring). Returns the
+    int32 start slot; the caller advances the cursor to
+    (start + block) % capacity.
+
+    Correct for ANY block size, including the non-dividing remainder a
+    single-chip shutdown flush ships: tail slots a skip leaves behind
+    keep their previous (still index-consistent) items, and callers
+    must grow `size` as min(max(size, start + block), capacity) —
+    NOT size + block — so never-written tail slots are never counted
+    as filled (ring_write_size below).
+    """
+    assert block <= capacity, (block, capacity)
+    return jnp.where(pos + block <= capacity, pos, 0).astype(jnp.int32)
+
+
+def ring_write_size(size: jax.Array, start: jax.Array, block: int,
+                    capacity: int) -> jax.Array:
+    """Filled-slot count after a skip-to-head ring write. Pre-fill the
+    ring fills [0, size) contiguously, so the new high-water mark is
+    max(size, start + block); a skip that restarts at 0 therefore does
+    NOT count the unwritten tail as filled (a plain size+block would —
+    and uniform sampling would then draw all-zero slots)."""
+    return jnp.minimum(jnp.maximum(size, start + block), capacity)
+
+
+def dus_rows(buf: jax.Array, block: jax.Array, start: jax.Array,
+             lead: int = 0) -> jax.Array:
+    """dynamic_update_slice of a block at row `start` on axis `lead` —
+    the in-place ring write (donated callers alias; scatter would
+    copy). Axes before `lead` are written at origin over their full
+    extent: the dist learners' lockstep form updates every [dp] shard
+    of a [dp, capacity, ...] buffer in the same DUS (lead=1), which is
+    what keeps the mesh add in place — a jax.vmap over the shard axis
+    would rebatch the DUS into a full-copy scatter."""
+    idx = ((jnp.int32(0),) * lead + (start,)
+           + (jnp.int32(0),) * (buf.ndim - lead - 1))
+    return jax.lax.dynamic_update_slice(buf, block.astype(buf.dtype), idx)
+
+
+def packable(spec) -> bool:
+    """Pack uint8 pixel leaves big enough that tile padding matters.
+
+    Small leaves (scalars, action vectors) stay in their natural layout
+    — their padding is bytes, and reshaping them would cost more in
+    decode than it saves.
+    """
+    return (np.dtype(spec.dtype) == np.uint8 and len(spec.shape) >= 2
+            and math.prod(spec.shape) >= 4096)
+
+
+class PixelPacker:
+    """Per-leaf codec: pixel frames <-> exactly-tiled byte rows.
+
+    Built from an item spec (pytree of ShapeDtypeStruct for ONE item).
+    `storage_spec` rewrites packable leaves to [pad128(nbytes)] uint8
+    rows; `encode` flattens+pads an incoming [b, ...] item block to row
+    form inside the add jit; `decode` restores a sampled [b, rows]
+    gather to the original frame shape (touches only the batch).
+    """
+
+    def __init__(self, item_spec: Any):
+        leaves, treedef = jax.tree.flatten(item_spec)
+        self._treedef = treedef
+        self._plan = []  # per leaf: None | (orig_shape, nbytes, row)
+        for leaf in leaves:
+            if packable(leaf):
+                nbytes = math.prod(leaf.shape)
+                self._plan.append((tuple(leaf.shape), nbytes,
+                                   pad128(nbytes)))
+            else:
+                self._plan.append(None)
+
+    @property
+    def packs_anything(self) -> bool:
+        return any(p is not None for p in self._plan)
+
+    def storage_spec(self, item_spec: Any) -> Any:
+        leaves = jax.tree.leaves(item_spec)
+        out = []
+        for leaf, plan in zip(leaves, self._plan):
+            if plan is None:
+                out.append(leaf)
+            else:
+                _, _, row = plan
+                out.append(jax.ShapeDtypeStruct((row,), jnp.uint8))
+        return jax.tree.unflatten(self._treedef, out)
+
+    def encode(self, items: Any) -> Any:
+        """[*lead, *orig] leaves -> [*lead, row] byte rows (zero pad).
+        Any number of leading batch axes ([b] single-chip, [dp, b] on
+        the mesh) — the item dims are always the trailing ones."""
+        leaves = jax.tree.leaves(items)
+        out = []
+        for leaf, plan in zip(leaves, self._plan):
+            if plan is None:
+                out.append(leaf)
+            else:
+                shape, nbytes, row = plan
+                lead = leaf.shape[:leaf.ndim - len(shape)]
+                flat = leaf.reshape(*lead, nbytes)
+                if row != nbytes:
+                    pad = [(0, 0)] * len(lead) + [(0, row - nbytes)]
+                    flat = jnp.pad(flat, pad)
+                out.append(flat)
+        return jax.tree.unflatten(self._treedef, out)
+
+    def decode(self, items: Any) -> Any:
+        """Sampled [*lead, row] byte rows -> [*lead, *orig] frames."""
+        leaves = jax.tree.leaves(items)
+        out = []
+        for leaf, plan in zip(leaves, self._plan):
+            if plan is None:
+                out.append(leaf)
+            else:
+                shape, nbytes, row = plan
+                lead = leaf.shape[:-1]
+                out.append(leaf[..., :nbytes].reshape(*lead, *shape))
+        return jax.tree.unflatten(self._treedef, out)
+
+
+def make_packer(item_spec: Any) -> tuple[PixelPacker | None, Any]:
+    """-> (packer or None, storage spec): the one place the packing
+    decision is made, shared by every replay class so storage layout
+    and the HBM budget (utils/hbm.py) cannot drift."""
+    spec = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), item_spec)
+    packer = PixelPacker(spec)
+    if packer.packs_anything:
+        return packer, packer.storage_spec(spec)
+    return None, spec

@@ -23,6 +23,9 @@ import jax
 import jax.numpy as jnp
 
 from ape_x_dqn_tpu.ops import sum_tree
+from ape_x_dqn_tpu.replay.packing import (PixelPacker, dus_rows,
+                                          make_packer, ring_write_size,
+                                          ring_write_start)
 
 
 class ReplayState(NamedTuple):
@@ -33,7 +36,14 @@ class ReplayState(NamedTuple):
 
 
 class PrioritizedReplay:
-    """Static config + pure state-transition functions."""
+    """Static config + pure state-transition functions.
+
+    Pixel leaves are stored as exactly-tiled byte rows and ring writes
+    are in-place dynamic_update_slice blocks with skip-to-head wrap —
+    see replay/packing.py for the measured HBM rationale (a scatter or
+    a tile-padded layout each cost a full-buffer copy per add/sample on
+    TPU).
+    """
 
     def __init__(self, capacity: int, alpha: float = 0.6, beta: float = 0.4,
                  eps: float = 1e-6):
@@ -43,38 +53,82 @@ class PrioritizedReplay:
         self.alpha = alpha
         self.beta = beta
         self.eps = eps
+        self._packer: PixelPacker | None = None
 
     # -- state construction ------------------------------------------------
 
     def init(self, item_spec: Any) -> ReplayState:
         """item_spec: pytree of ShapeDtypeStruct (or arrays) for ONE item."""
+        self._packer, spec = make_packer(item_spec)
         storage = jax.tree.map(
-            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype),
-            item_spec)
+            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype), spec)
         return ReplayState(
             storage=storage, tree=sum_tree.init(self.capacity),
             pos=jnp.int32(0), size=jnp.int32(0))
 
     # -- transitions (all pure, jit-friendly) ------------------------------
 
+    def _write_block(self, state: ReplayState, items: Any,
+                     td_abs: jax.Array,
+                     lead: tuple[int, ...]) -> ReplayState:
+        """Shared body of `add` (lead=()) and `add_lockstep`
+        (lead=(dp,)): one in-place dynamic_update_slice block per leaf
+        with skip-to-head wrap; only the small per-shard sum-trees go
+        through vmap on the lockstep path."""
+        nl = len(lead)
+        b = td_abs.shape[nl]
+        pos0 = state.pos if nl == 0 else state.pos[0]
+        size0 = state.size if nl == 0 else state.size[0]
+        start = ring_write_start(pos0, b, self.capacity)
+        idx = start + jnp.arange(b, dtype=jnp.int32)  # same every shard
+        if self._packer is not None:
+            items = self._packer.encode(items)
+        storage = jax.tree.map(
+            lambda buf, x: dus_rows(buf, x, start, lead=nl),
+            state.storage, items)
+        pri = (td_abs + self.eps) ** self.alpha
+        pos1 = (start + b) % self.capacity
+        size1 = ring_write_size(size0, start, b, self.capacity)
+        if nl == 0:
+            tree = sum_tree.update(state.tree, idx, pri)
+            return ReplayState(storage=storage, tree=tree,
+                               pos=pos1, size=size1)
+        tree = jax.vmap(sum_tree.update, in_axes=(0, None, 0))(
+            state.tree, idx, pri)
+        return ReplayState(
+            storage=storage, tree=tree,
+            pos=jnp.full(lead, pos1, jnp.int32),
+            size=jnp.full(lead, size1, jnp.int32))
+
     def add(self, state: ReplayState, items: Any,
             td_abs: jax.Array) -> ReplayState:
         """Append a batch of items with initial |TD| priorities.
 
-        items: pytree of [B, ...] arrays; td_abs: [B] f32.
-        Overwrites FIFO when full (ring semantics via modular cursor).
+        items: pytree of [B, ...] arrays; td_abs: [B] f32. Overwrites
+        FIFO when full; a block that would cross the ring boundary is
+        written at slot 0 instead (skip-to-head — identical to modular
+        semantics whenever the block size divides the capacity, which
+        every fixed-block ingest staging guarantees).
         """
-        b = td_abs.shape[0]
-        idx = (state.pos + jnp.arange(b, dtype=jnp.int32)) % self.capacity
-        storage = jax.tree.map(
-            lambda buf, x: buf.at[idx].set(x.astype(buf.dtype)),
-            state.storage, items)
-        pri = (td_abs + self.eps) ** self.alpha
-        tree = sum_tree.update(state.tree, idx, pri)
-        return ReplayState(
-            storage=storage, tree=tree,
-            pos=(state.pos + b) % self.capacity,
-            size=jnp.minimum(state.size + b, self.capacity))
+        return self._write_block(state, items, td_abs, lead=())
+
+    def add_lockstep(self, state: ReplayState, items: Any,
+                     td_abs: jax.Array) -> ReplayState:
+        """`add` for [dp, ...]-stacked shard states whose cursors
+        advance in LOCKSTEP — the dist ingest contract (every add ships
+        equal-size [dp, B] blocks, so all shard cursors are equal by
+        induction from init).
+
+        Why not jax.vmap(add): vmap's batching rule rewrites
+        dynamic_update_slice into lax.scatter, and a scatter into a
+        donated buffer materializes a full-buffer HLO temp copy
+        (measured 19.1GB on a 9.47GB ring — replay/packing.py). The
+        lockstep form writes all shards with ONE multi-axis DUS at
+        (0, start, 0...) covering the full dp extent, which stays in
+        place (verified: temp=0 at the atari57 per-shard scale).
+        """
+        return self._write_block(state, items, td_abs,
+                                 lead=(td_abs.shape[0],))
 
     def sample_items(self, state: ReplayState, rng: jax.Array, batch: int
                      ) -> tuple[Any, jax.Array, jax.Array]:
@@ -85,6 +139,8 @@ class PrioritizedReplay:
         idx, probs = sum_tree.sample(state.tree, rng, batch,
                                      size=state.size)
         items = jax.tree.map(lambda buf: buf[idx], state.storage)
+        if self._packer is not None:
+            items = self._packer.decode(items)
         return items, idx, probs
 
     def sample(self, state: ReplayState, rng: jax.Array, batch: int
@@ -136,11 +192,12 @@ class UniformReplayDevice:
     def __init__(self, capacity: int):
         assert capacity > 0 and (capacity & (capacity - 1)) == 0
         self.capacity = capacity
+        self._packer: PixelPacker | None = None
 
     def init(self, item_spec: Any) -> ReplayState:
+        self._packer, spec = make_packer(item_spec)
         storage = jax.tree.map(
-            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype),
-            item_spec)
+            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype), spec)
         return ReplayState(storage=storage,
                            tree=jnp.zeros(1, jnp.float32),  # unused
                            pos=jnp.int32(0), size=jnp.int32(0))
@@ -148,19 +205,22 @@ class UniformReplayDevice:
     def add(self, state: ReplayState, items: Any,
             td_abs: jax.Array | None = None) -> ReplayState:
         b = jax.tree.leaves(items)[0].shape[0]
-        idx = (state.pos + jnp.arange(b, dtype=jnp.int32)) % self.capacity
+        start = ring_write_start(state.pos, b, self.capacity)
+        if self._packer is not None:
+            items = self._packer.encode(items)
         storage = jax.tree.map(
-            lambda buf, x: buf.at[idx].set(x.astype(buf.dtype)),
-            state.storage, items)
+            lambda buf, x: dus_rows(buf, x, start), state.storage, items)
         return ReplayState(
             storage=storage, tree=state.tree,
-            pos=(state.pos + b) % self.capacity,
-            size=jnp.minimum(state.size + b, self.capacity))
+            pos=(start + b) % self.capacity,
+            size=ring_write_size(state.size, start, b, self.capacity))
 
     def sample(self, state: ReplayState, rng: jax.Array, batch: int):
         idx = jax.random.randint(rng, (batch,), 0,
                                  jnp.maximum(state.size, 1))
         items = jax.tree.map(lambda buf: buf[idx], state.storage)
+        if self._packer is not None:
+            items = self._packer.decode(items)
         return items, idx, jnp.ones(batch, jnp.float32)
 
     def update_priorities(self, state: ReplayState, idx, td_abs):

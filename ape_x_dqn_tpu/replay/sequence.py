@@ -18,6 +18,16 @@ from typing import Any
 import numpy as np
 
 
+def sequence_frame_mode(storage: str, obs_shape: tuple[int, ...]) -> bool:
+    """THE predicate for single-frame sequence storage — shared by
+    runtime/family.py (layout selection) and utils/hbm.py (budget
+    pricing) so the two can never drift: frame mode applies to
+    [H, W, stack] pixel observations under frame_ring storage, any
+    dtype (the byte-row packing inside the replay additionally engages
+    only for uint8, but the item SHAPE is the same either way)."""
+    return storage == "frame_ring" and len(obs_shape) == 3
+
+
 def sequence_item_spec(obs_shape: tuple[int, ...], obs_dtype,
                        seq_len: int, lstm_size: int,
                        frame_mode: bool = False) -> dict:

@@ -18,7 +18,8 @@ import numpy as np
 
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
-from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
+from ape_x_dqn_tpu.replay.sequence import (sequence_frame_mode,
+                                           sequence_item_spec)
 from ape_x_dqn_tpu.runtime.actor import (
     Actor, ContinuousActor, RecurrentActor)
 from ape_x_dqn_tpu.utils.rng import component_key
@@ -116,8 +117,9 @@ def family_setup(cfg: RunConfig, spec: Any, net: Any,
         z = jnp.zeros((1, cfg.network.lstm_size), jnp.float32)
         params = net.init(component_key(cfg.seed, "net_init"),
                           obs0[None, None], (z, z))
-        seq_frame_mode = cfg.replay.storage == "frame_ring"
-        if seq_frame_mode and len(spec.obs_shape) != 3:
+        seq_frame_mode = sequence_frame_mode(cfg.replay.storage,
+                                             spec.obs_shape)
+        if cfg.replay.storage == "frame_ring" and not seq_frame_mode:
             raise ValueError(
                 f"frame_ring sequence storage needs [H, W, stack] "
                 f"pixel obs, got {spec.obs_shape}; set "

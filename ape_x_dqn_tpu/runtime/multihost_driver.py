@@ -66,6 +66,7 @@ from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, family_setup, server_apply_fn,
     warmup_example)
 from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
+from ape_x_dqn_tpu.utils.hbm import check_hbm_fits
 from ape_x_dqn_tpu.utils.metrics import Metrics, log_run_header
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
@@ -221,6 +222,14 @@ class MultihostApexDriver:
                 "(the per-shard sum-trees ARE the sharded state; "
                 "kind='sequence' for R2D2); got "
                 f"replay.kind={cfg.replay.kind!r}")
+
+        # early, loud HBM fits-check (utils/hbm.py): the per-shard
+        # replay + replicated model state must fit each chip before any
+        # device allocation happens
+        check_hbm_fits(
+            cfg, self.spec.obs_shape, self.spec.obs_dtype,
+            param_count=sum(int(np.prod(l.shape))
+                            for l in jax.tree.leaves(params)))
 
         # identical construction on every process (same cfg.seed) ->
         # identical initial params; learner.init then shards them over

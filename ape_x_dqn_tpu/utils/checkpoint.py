@@ -7,6 +7,16 @@ latest checkpoint at construction; replay contents are not saved (large,
 and Ape-X regenerates them — actors refill the buffer on resume).
 ``tests/test_checkpoint.py`` asserts the round-trip is bitwise and that a
 resumed run continues the grad-step counter.
+
+FORMAT BREAK (round 5): replay-bearing checkpoints
+(``RunConfig.checkpoint_replay=True``) written before the byte-row
+storage layout (replay/packing.py — frames [S*F, pad128(H*W)] instead
+of [S*F, H, W] planes, packed pixel obs rows in flat storage) do not
+restore into the new layout: the Orbax template mirrors the CURRENT
+storage shapes and the restore fails with a structure mismatch at
+startup. Param-only checkpoints (the default) are unaffected. Restart
+replay-bearing runs fresh, or restore on the old code and re-save
+params-only.
 """
 
 from __future__ import annotations

