@@ -185,6 +185,14 @@ class RunConfig:
     # episode left the rotation unable to finish a single eval while
     # training saturated the device (PERF.md "Live multi-game").
     eval_max_frames: int = 108_000
+    # Wall-clock budget for the END-OF-RUN eval backstop (the greedy
+    # eval the driver guarantees when a run finishes without a periodic
+    # eval having completed). The old hard-coded 60s silently returned
+    # no eval on hosts where each eval env-step crosses a slow
+    # host<->device link (~30ms/step on this rig's tunnel: 5 episodes x
+    # 2000 steps ~ 300s) — a fully-trained suite game then recorded
+    # eval=null and was discarded (round-5 suite-learning run).
+    final_eval_deadline_s: float = 600.0
     checkpoint_dir: str = ""
     checkpoint_every: int = 50_000
     # Opt-in, SINGLE-HOST driver only (the multihost driver rejects it:

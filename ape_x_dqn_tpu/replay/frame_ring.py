@@ -63,10 +63,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.ops import sum_tree
-from ape_x_dqn_tpu.replay.packing import (dus_rows, pad128,
-                                          ring_write_size,
-                                          ring_write_start)
-from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
+from ape_x_dqn_tpu.replay.packing import dus_rows, pad128
+from ape_x_dqn_tpu.replay.prioritized import (PrioritizedReplay,
+                                              ReplayState, ring_cursor,
+                                              ring_finish)
+
+
+def frame_ring_mode(storage: str, obs_shape: tuple[int, ...]) -> bool:
+    """THE predicate for frame-segment storage in the flat-DQN family —
+    shared by runtime/family.py (layout selection) and utils/hbm.py
+    (budget pricing), mirroring replay/sequence.sequence_frame_mode so
+    the two can never drift: frame-ring applies to [H, W, stack] pixel
+    observations (the dtype requirement — uint8 — is enforced with a
+    ValueError at FrameRingReplay construction)."""
+    return storage == "frame_ring" and len(obs_shape) == 3
 
 
 def frame_segment_spec(seg_transitions: int, n_step: int,
@@ -192,11 +202,14 @@ class FrameRingReplay(PrioritizedReplay):
                  obs_shape: tuple[int, ...], obs_dtype=np.uint8,
                  alpha: float = 0.6, beta: float = 0.4, eps: float = 1e-6):
         super().__init__(capacity=capacity, alpha=alpha, beta=beta, eps=eps)
-        assert capacity % seg_transitions == 0, \
-            "segment size must divide capacity"
-        assert len(obs_shape) == 3, \
-            f"frame-ring replay needs [H, W, stack] pixel obs, " \
-            f"got {obs_shape}"
+        # ValueError, not assert: user-config validation must survive
+        # `python -O` (same rule as the multihost driver's kind check)
+        if capacity % seg_transitions != 0:
+            raise ValueError("segment size must divide capacity")
+        if len(obs_shape) != 3:
+            raise ValueError(
+                f"frame-ring replay needs [H, W, stack] pixel obs, "
+                f"got {obs_shape}")
         self.B = seg_transitions
         self.n = n_step
         self.h, self.w, self.stack = obs_shape
@@ -205,8 +218,11 @@ class FrameRingReplay(PrioritizedReplay):
         self.frame_bytes = self.h * self.w
         self.frame_row = pad128(self.frame_bytes)
         self.obs_dtype = obs_dtype
-        assert np.dtype(obs_dtype) == np.uint8, \
-            "frame-ring byte-row storage assumes uint8 frames"
+        if np.dtype(obs_dtype) != np.uint8:
+            raise ValueError(
+                f"frame-ring byte-row storage requires uint8 frames "
+                f"(got {np.dtype(obs_dtype)}); use replay.storage='flat' "
+                f"for non-uint8 pixel observations")
 
     # -- state construction ------------------------------------------------
 
@@ -237,9 +253,9 @@ class FrameRingReplay(PrioritizedReplay):
         wrap at the segment cursor."""
         nl = len(lead)
         g = td_abs.shape[nl]
-        pos0 = state.pos if nl == 0 else state.pos[0]
-        size0 = state.size if nl == 0 else state.size[0]
-        seg0 = ring_write_start(pos0, g, self.S)
+        # cursor counts SEGMENTS, size counts transitions (size_scale)
+        seg0, pos1, size1 = ring_cursor(state.pos, state.size, g, self.S,
+                                        nl, size_scale=self.B)
         tidx = seg0 * self.B + jnp.arange(g * self.B, dtype=jnp.int32)
         rows = items["seg_frames"].astype(self.obs_dtype) \
             .reshape(*lead, g * self.F, self.frame_bytes)
@@ -258,19 +274,9 @@ class FrameRingReplay(PrioritizedReplay):
             valid,
             (td_abs.reshape(*lead, g * self.B) + self.eps) ** self.alpha,
             0.0)
-        pos1 = (seg0 + g) % self.S
-        size1 = ring_write_size(size0, seg0 * self.B, g * self.B,
-                                self.capacity)
-        if nl == 0:
-            tree = sum_tree.update(state.tree, tidx, pri)
-            return ReplayState(storage=storage, tree=tree,
-                               pos=pos1, size=size1)
-        tree = jax.vmap(sum_tree.update, in_axes=(0, None, 0))(
-            state.tree, tidx, pri)
-        return ReplayState(
-            storage=storage, tree=tree,
-            pos=jnp.full(lead, pos1, jnp.int32),
-            size=jnp.full(lead, size1, jnp.int32))
+        tree, pos, size = ring_finish(state.tree, tidx, pri, pos1, size1,
+                                      lead)
+        return ReplayState(storage=storage, tree=tree, pos=pos, size=size)
 
     def add(self, state: ReplayState, items: Any,
             td_abs: jax.Array) -> ReplayState:

@@ -35,6 +35,35 @@ class ReplayState(NamedTuple):
     size: jax.Array       # int32 filled slots
 
 
+def ring_cursor(pos, size, block: int, capacity: int, nl: int,
+                size_scale: int = 1):
+    """Skip-to-head cursor math shared by every ring layout and both
+    the single-chip (nl=0) and lockstep-dist (nl=1, [dp]-vector
+    cursors) forms: -> (start, new_pos, new_size). `size_scale`
+    converts cursor units to size units (the frame ring's cursor
+    counts segments while its size counts transitions)."""
+    pos0 = pos if nl == 0 else pos[0]
+    size0 = size if nl == 0 else size[0]
+    start = ring_write_start(pos0, block, capacity)
+    pos1 = (start + block) % capacity
+    size1 = ring_write_size(size0, start * size_scale,
+                            block * size_scale, capacity * size_scale)
+    return start, pos1, size1
+
+
+def ring_finish(tree, idx, pri, pos1, size1, lead: tuple[int, ...]):
+    """Tree write-back + cursor broadcast shared by every ring layout:
+    single-chip (lead=()) updates the one tree; the lockstep-dist form
+    vmaps the small per-shard trees (the storage itself was already
+    written with one multi-axis DUS) and broadcasts the common cursor
+    to [dp] vectors. -> (tree, pos, size)."""
+    if not lead:
+        return sum_tree.update(tree, idx, pri), pos1, size1
+    tree = jax.vmap(sum_tree.update, in_axes=(0, None, 0))(tree, idx, pri)
+    return (tree, jnp.full(lead, pos1, jnp.int32),
+            jnp.full(lead, size1, jnp.int32))
+
+
 class PrioritizedReplay:
     """Static config + pure state-transition functions.
 
@@ -77,9 +106,8 @@ class PrioritizedReplay:
         through vmap on the lockstep path."""
         nl = len(lead)
         b = td_abs.shape[nl]
-        pos0 = state.pos if nl == 0 else state.pos[0]
-        size0 = state.size if nl == 0 else state.size[0]
-        start = ring_write_start(pos0, b, self.capacity)
+        start, pos1, size1 = ring_cursor(state.pos, state.size, b,
+                                         self.capacity, nl)
         idx = start + jnp.arange(b, dtype=jnp.int32)  # same every shard
         if self._packer is not None:
             items = self._packer.encode(items)
@@ -87,18 +115,9 @@ class PrioritizedReplay:
             lambda buf, x: dus_rows(buf, x, start, lead=nl),
             state.storage, items)
         pri = (td_abs + self.eps) ** self.alpha
-        pos1 = (start + b) % self.capacity
-        size1 = ring_write_size(size0, start, b, self.capacity)
-        if nl == 0:
-            tree = sum_tree.update(state.tree, idx, pri)
-            return ReplayState(storage=storage, tree=tree,
-                               pos=pos1, size=size1)
-        tree = jax.vmap(sum_tree.update, in_axes=(0, None, 0))(
-            state.tree, idx, pri)
-        return ReplayState(
-            storage=storage, tree=tree,
-            pos=jnp.full(lead, pos1, jnp.int32),
-            size=jnp.full(lead, size1, jnp.int32))
+        tree, pos, size = ring_finish(state.tree, idx, pri, pos1, size1,
+                                      lead)
+        return ReplayState(storage=storage, tree=tree, pos=pos, size=size)
 
     def add(self, state: ReplayState, items: Any,
             td_abs: jax.Array) -> ReplayState:

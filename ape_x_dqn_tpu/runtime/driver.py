@@ -799,7 +799,7 @@ class ApexDriver:
                     res = self._make_eval_worker(game=game).run(
                         self.cfg.eval_episodes,
                         max_frames=self.cfg.eval_max_frames,
-                        deadline_s=60.0)
+                        deadline_s=self.cfg.final_eval_deadline_s)
                     if res is not None:
                         self.last_eval = res
                         self.metrics.log(self._grad_steps_total,

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.configs import RunConfig
-from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
+from ape_x_dqn_tpu.replay.frame_ring import (frame_ring_mode,
+                                             frame_segment_spec)
 from ape_x_dqn_tpu.replay.sequence import (sequence_frame_mode,
                                            sequence_item_spec)
 from ape_x_dqn_tpu.runtime.actor import (
@@ -153,6 +154,11 @@ def family_setup(cfg: RunConfig, spec: Any, net: Any,
             raise NotImplementedError(
                 "flat-family frame_ring storage requires prioritized "
                 "replay")
+        if not frame_ring_mode(cfg.replay.storage, spec.obs_shape):
+            raise ValueError(
+                f"frame_ring storage needs [H, W, stack] pixel obs, "
+                f"got {spec.obs_shape}; set replay.storage='flat' for "
+                f"vector observations")
         item_spec = frame_segment_spec(
             cfg.replay.seg_transitions, cfg.learner.n_step,
             spec.obs_shape, spec.obs_dtype)

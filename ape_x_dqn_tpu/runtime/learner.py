@@ -55,18 +55,19 @@ def make_optimizer(lcfg) -> optax.GradientTransformation:
     )
 
 
-class DQNLearner:
-    """Builds the jitted endpoints for a flat-transition DQN learner."""
+class SingleChipLearner:
+    """Shared single-chip learner machinery: state init, the exact
+    per-step path, the K-batch relaxation, the train_many scan, the
+    ingest add, and param publication.
 
-    def __init__(self, net_apply: Callable, replay, lcfg,
-                 optimizer: optax.GradientTransformation | None = None):
-        self.net_apply = net_apply
-        self.replay = replay
-        self.lcfg = lcfg
-        self.optimizer = optimizer or make_optimizer(lcfg)
-        self.loss_fn = make_dqn_loss(
-            net_apply, double=lcfg.double_dqn, huber_delta=lcfg.huber_delta,
-            rescale=lcfg.value_rescale)
+    Subclasses provide `self.replay`, `self.lcfg`, `self.optimizer`
+    and define `_sgd_step(params, target_params, opt_state, step,
+    items, is_w) -> (params, target_params, opt_state, step, td_abs,
+    metrics)` — the only family-specific piece (batch construction +
+    loss). The K-batch semantics (interleaved strata, per-chunk IS
+    renorm, one write-back, remainder-first metrics) therefore cannot
+    drift between the flat-DQN and sequence learners.
+    """
 
     # -- state ------------------------------------------------------------
 
@@ -86,32 +87,7 @@ class DQNLearner:
 
     def _sgd_step(self, params, target_params, opt_state, step,
                   items, is_w):
-        """One loss/grad/optimizer/target-sync update on an already-
-        sampled batch (shared by the exact per-step path and the
-        K-batch relaxation)."""
-        batch = TransitionBatch(
-            obs=items["obs"], actions=items["action"],
-            rewards=items["reward"], next_obs=items["next_obs"],
-            discounts=items["discount"])
-        (loss, aux), grads = jax.value_and_grad(
-            self.loss_fn, has_aux=True)(
-            params, target_params, batch, is_w)
-        updates, opt_state = self.optimizer.update(
-            grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        step = step + 1
-        # hard target sync every K steps, branchless (SURVEY.md §3.3)
-        sync = (step % self.lcfg.target_sync_every == 0)
-        target_params = jax.tree.map(
-            lambda t, p: jnp.where(sync, p, t), target_params, params)
-        metrics = {
-            "loss": loss,
-            "q_mean": aux["q_mean"],
-            "td_abs_mean": aux["td_abs"].mean(),
-            "grad_norm": optax.global_norm(grads),
-        }
-        return params, target_params, opt_state, step, aux["td_abs"], \
-            metrics
+        raise NotImplementedError  # family-specific: batch + loss
 
     def _train_step(self, state: TrainState) -> tuple[TrainState, dict]:
         rng, sk = jax.random.split(state.rng)
@@ -246,3 +222,46 @@ class DQNLearner:
         jits donate the TrainState, so aliased buffers would be deleted
         under the server's feet."""
         return jax.tree.map(jnp.copy, state.params)
+
+
+class DQNLearner(SingleChipLearner):
+    """Jitted endpoints for the flat-transition DQN learner."""
+
+    def __init__(self, net_apply: Callable, replay, lcfg,
+                 optimizer: optax.GradientTransformation | None = None):
+        self.net_apply = net_apply
+        self.replay = replay
+        self.lcfg = lcfg
+        self.optimizer = optimizer or make_optimizer(lcfg)
+        self.loss_fn = make_dqn_loss(
+            net_apply, double=lcfg.double_dqn, huber_delta=lcfg.huber_delta,
+            rescale=lcfg.value_rescale)
+
+    def _sgd_step(self, params, target_params, opt_state, step,
+                  items, is_w):
+        """One loss/grad/optimizer/target-sync update on an already-
+        sampled batch (shared by the exact per-step path and the
+        K-batch relaxation)."""
+        batch = TransitionBatch(
+            obs=items["obs"], actions=items["action"],
+            rewards=items["reward"], next_obs=items["next_obs"],
+            discounts=items["discount"])
+        (loss, aux), grads = jax.value_and_grad(
+            self.loss_fn, has_aux=True)(
+            params, target_params, batch, is_w)
+        updates, opt_state = self.optimizer.update(
+            grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        step = step + 1
+        # hard target sync every K steps, branchless (SURVEY.md §3.3)
+        sync = (step % self.lcfg.target_sync_every == 0)
+        target_params = jax.tree.map(
+            lambda t, p: jnp.where(sync, p, t), target_params, params)
+        metrics = {
+            "loss": loss,
+            "q_mean": aux["q_mean"],
+            "td_abs_mean": aux["td_abs"].mean(),
+            "grad_norm": optax.global_norm(grads),
+        }
+        return params, target_params, opt_state, step, aux["td_abs"], \
+            metrics

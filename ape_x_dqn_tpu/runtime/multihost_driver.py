@@ -840,7 +840,7 @@ class MultihostApexDriver:
                 res = self._make_eval_worker(game=game).run(
                     cfg.eval_episodes,
                     max_frames=cfg.eval_max_frames,
-                    deadline_s=60.0)
+                    deadline_s=cfg.final_eval_deadline_s)
                 if res is not None:
                     self.last_eval = res
                     self.metrics.log(

@@ -26,6 +26,7 @@ from typing import Any
 
 import numpy as np
 
+from ape_x_dqn_tpu.replay.frame_ring import frame_ring_mode
 from ape_x_dqn_tpu.replay.packing import packable, pad128
 from ape_x_dqn_tpu.replay.sequence import sequence_frame_mode
 from ape_x_dqn_tpu.utils.misc import next_pow2
@@ -116,7 +117,6 @@ def replay_budget(cfg: Any, obs_shape: tuple[int, ...],
     dp = max(getattr(cfg.parallel, "dp", 1), 1)
     cap = next_pow2(max(r.capacity // dp, 2)) if dp > 1 \
         else next_pow2(r.capacity)
-    pixel = len(obs_shape) == 3 and np.dtype(obs_dtype) == np.uint8
     if r.kind == "sequence":
         storage, detail = _sequence_bytes(
             cap, r.seq_length, obs_shape, obs_dtype,
@@ -124,7 +124,7 @@ def replay_budget(cfg: Any, obs_shape: tuple[int, ...],
             # the SHARED predicate (replay/sequence.py) — pricing must
             # follow the layout runtime/family.py actually selects
             frame_mode=sequence_frame_mode(r.storage, obs_shape))
-    elif r.storage == "frame_ring" and pixel:
+    elif frame_ring_mode(r.storage, obs_shape):
         storage, detail = _frame_ring_bytes(
             cap, r.seg_transitions, cfg.learner.n_step, obs_shape)
     else:

@@ -97,25 +97,32 @@ def test_hns():
 def test_sample_chunk_gated_for_unimplemented_families():
     """Families without the K-batch relaxation must reject
     sample_chunk>1 loudly, not silently train exact semantics under a
-    config that claims otherwise."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+    config that claims otherwise. (Round 5: the SequenceLearner now
+    implements K-batch — tests/test_r2d2_runtime.py covers its
+    mechanics — so only DPG keeps the gate.)"""
     import pytest
 
-    from ape_x_dqn_tpu.configs import LearnerConfig, ReplayConfig
+    from ape_x_dqn_tpu.configs import LearnerConfig
     from ape_x_dqn_tpu.models import DPGActor, DPGCritic
     from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
     from ape_x_dqn_tpu.runtime.dpg_learner import DPGLearner
-    from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 
     lcfg = LearnerConfig(batch_size=8, sample_chunk=4)
-    with pytest.raises(ValueError, match="sample_chunk"):
-        SequenceLearner(lambda p, o, s: (o, s),
-                        PrioritizedReplay(capacity=64), lcfg,
-                        ReplayConfig(kind="sequence"))
     actor = DPGActor(action_dim=1, action_low=-1, action_high=1)
     critic = DPGCritic()
     with pytest.raises(ValueError, match="sample_chunk"):
         DPGLearner(actor.apply, critic.apply,
                    PrioritizedReplay(capacity=64), lcfg)
+
+
+def test_final_eval_deadline_is_configurable():
+    """The end-of-run eval backstop budget must come from RunConfig —
+    a hard-coded 60s deadline silently discarded fully-trained suite
+    games on slow-link hosts (round-5 suite-learning run: eval=null
+    after 45k frames of training)."""
+    from ape_x_dqn_tpu.configs import get_config
+
+    cfg = get_config("pong")
+    assert cfg.final_eval_deadline_s >= 300.0
+    assert get_config("pong", final_eval_deadline_s=30.0) \
+        .final_eval_deadline_s == 30.0

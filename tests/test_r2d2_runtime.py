@@ -282,3 +282,93 @@ def test_r2d2_improves_masked_cartpole():
     # plateau (~22) keeps the slope assertion robust
     assert out["eval"] is not None
     assert out["eval"]["mean_return"] > 35, out["eval"]
+
+
+def _seq_learner_with_items(sample_chunk=1, n_items=64, seed=0):
+    """Small SequenceLearner + filled replay for mechanics tests."""
+    net = ApeXLSTMQNet(num_actions=2, lstm_size=8, dense=16,
+                       compute_dtype="float32", mlp_torso=True)
+    z = jnp.zeros((1, 8), jnp.float32)
+    params = net.init(jax.random.key(0),
+                      jnp.zeros((1, 4, 2), jnp.float32), (z, z))
+    replay = PrioritizedReplay(capacity=128)
+    spec = sequence_item_spec((2,), np.float32, 4, 8)
+    lcfg = LearnerConfig(batch_size=8, n_step=2, value_rescale=True,
+                         target_sync_every=3, lr=1e-3,
+                         sample_chunk=sample_chunk)
+    rcfg = ReplayConfig(kind="sequence", seq_length=4, burn_in=1)
+    learner = SequenceLearner(lambda p, o, s: net.apply(p, o, s),
+                              replay, lcfg, rcfg)
+    state = learner.init(params, replay.init(spec), jax.random.key(1))
+    rng = np.random.default_rng(seed)
+    items = {
+        "obs": jnp.asarray(rng.normal(size=(n_items, 4, 2)), jnp.float32),
+        "actions": jnp.asarray(rng.integers(0, 2, (n_items, 4)), jnp.int32),
+        "rewards": jnp.asarray(rng.normal(size=(n_items, 4)), jnp.float32),
+        "terminals": jnp.zeros((n_items, 4), jnp.float32),
+        "mask": jnp.ones((n_items, 4), jnp.float32),
+        "init_c": jnp.zeros((n_items, 8), jnp.float32),
+        "init_h": jnp.zeros((n_items, 8), jnp.float32),
+    }
+    state = learner.add(
+        state, items,
+        jnp.asarray(rng.random(n_items) + 0.1, jnp.float32))
+    return learner, state
+
+
+def test_sequence_kbatch_train_many_mechanics():
+    """sample_chunk=K on the SequenceLearner (round-5 verdict item 5):
+    one stratified K*B sequence sample + one priority write-back per K
+    grad-steps; step counts, the remainder path, target sync inside the
+    macro-step, and tree repair must all hold — mirroring
+    test_runtime.test_kbatch_train_many_mechanics for flat DQN."""
+    learner, state = _seq_learner_with_items(sample_chunk=4)
+    tree_before = np.asarray(state.replay.tree).copy()
+
+    state, m = learner.train_many(state, 8)   # pure macro-steps
+    assert int(state.step) == 8
+    assert np.isfinite(m["loss"]) and m["valid_frac"] > 0
+    assert np.asarray(state.replay.tree)[1] != tree_before[1]
+
+    state, m = learner.train_many(state, 10)  # 2 exact + 2 macro-steps
+    assert int(state.step) == 18
+    assert np.isfinite(m["loss"])
+
+    # step 18 is a sync boundary (sync_every=3): targets == online
+    t = jax.tree.leaves(jax.tree.map(np.asarray, state.target_params))
+    p = jax.tree.leaves(jax.tree.map(np.asarray, state.params))
+    for a, b in zip(t, p):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_sequence_kbatch_determinism():
+    """Same seed, same params through the sequence K-batch path."""
+    def run():
+        learner, state = _seq_learner_with_items(sample_chunk=4, seed=3)
+        state, _ = learner.train_many(state, 12)
+        return jax.tree.map(np.asarray, state.params)
+    a, b = run(), run()
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def test_dist_sequence_kbatch_train_step_k():
+    """K-batch mechanics on the DIST sequence learner (round-4 advisor
+    finding: DistSequenceLearner inherited the K path with no test):
+    the dp=4 x tp=2 driver trains with sample_chunk=4 through
+    train_many, steps count correctly, and every shard's tree is
+    repaired."""
+    from ape_x_dqn_tpu.parallel.dist_learner import DistSequenceLearner
+
+    cfg = _r2d2_cfg(num_actors=2).replace(
+        parallel=ParallelConfig(dp=4, tp=2))
+    cfg = cfg.replace(learner=dataclasses.replace(cfg.learner,
+                                                  sample_chunk=4))
+    driver = ApexDriver(cfg)
+    assert isinstance(driver.learner, DistSequenceLearner)
+    out = driver.run(total_env_frames=2500, max_grad_steps=40,
+                     wall_clock_limit_s=240)
+    assert out["actor_errors"] == [], out["actor_errors"]
+    assert out["loop_errors"] == [], out["loop_errors"]
+    assert out["grad_steps"] >= 40, out
+    sizes = np.asarray(driver.state.replay.size)
+    assert sizes.shape == (4,) and (sizes > 0).all(), sizes
