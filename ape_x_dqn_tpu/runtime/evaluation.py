@@ -55,6 +55,16 @@ class EvalWorker:
         self.policy_factory = policy_factory
         self.eps = cfg.eval_eps
         self.rng = np.random.default_rng(seed)
+        # eval_max_frames is specified in RAW env frames (the Atari
+        # protocol's 108k = 30 min @ 60Hz) but the episode loop counts
+        # AGENT steps; a skipped env consumes frame_skip raw frames per
+        # step. Counting steps against the raw budget made the cap 4x
+        # looser than documented — on slow-link hosts that blew the
+        # whole final-eval deadline on one episode (round-5 suite run:
+        # a trained game recorded eval=null and was discarded).
+        self._frames_per_step = (
+            env_cfg.frame_skip
+            if env_cfg.kind in ("atari", "synthetic_atari") else 1)
 
     def run_episode(self, max_frames: int = 108_000,
                     stop_event=None,
@@ -67,7 +77,7 @@ class EvalWorker:
         discrete = self.env.spec.discrete
         obs = self.env.reset()
         ep_return = 0.0
-        for _ in range(max_frames):
+        for _ in range(max(max_frames // self._frames_per_step, 1)):
             if stop_event is not None and stop_event.is_set():
                 return None
             if deadline is not None and time.monotonic() > deadline:

@@ -1,0 +1,92 @@
+"""Batch-size / MFU scaling study on the real chip (round-5 verdict
+item 3): grad-steps/s, samples/s, and MFU at batch 512/1024/2048 with
+constant replay capacity, interleaved A-B-C-C-B-A order so machine
+drift cancels (the same discipline as PERF.md's K-batch A/B).
+
+Usage:
+    python -m ape_x_dqn_tpu.utils.batch_study [--capacity 1048576]
+
+Prints one JSON line per measurement plus a summary table to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# bench.py lives at the repo root, not inside the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+
+def measure_one(batch_size: int, capacity: int, steps: int,
+                dispatches: int, sample_chunk: int,
+                peak_tflops: float) -> dict:
+    import jax
+
+    from bench import (bench_learner, build_learner, prefill,
+                       train_step_flops_analytic)
+
+    net, learner, state, spec = build_learner(
+        capacity, batch_size, "frame_ring", sample_chunk)
+    state, _ = prefill(learner, state, spec, 1 << 15, "frame_ring",
+                       repeats=1)
+    rates, state = bench_learner(learner, state, steps, dispatches,
+                                 repeats=3)
+    del state, learner, net
+    jax.clear_caches()
+    gsps = float(np.median(rates))
+    flops = train_step_flops_analytic(batch_size)
+    return {
+        "batch_size": batch_size,
+        "grad_steps_per_s": round(gsps, 1),
+        "spread": [round(float(np.min(rates)), 1),
+                   round(float(np.max(rates)), 1)],
+        "samples_per_s": round(gsps * batch_size),
+        "achieved_tflops": round(gsps * flops / 1e12, 2),
+        "mfu": round(gsps * flops / 1e12 / peak_tflops, 4),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--capacity", type=int, default=1 << 20)
+    p.add_argument("--batches", default="512,1024,2048")
+    p.add_argument("--steps-per-dispatch", type=int, default=50)
+    p.add_argument("--dispatches", type=int, default=8)
+    p.add_argument("--sample-chunk", type=int, default=4)
+    p.add_argument("--peak-tflops", type=float, default=197.0,
+                   help="chip peak bf16 TFLOP/s (bench.py's default)")
+    args = p.parse_args()
+
+    batches = [int(b) for b in args.batches.split(",")]
+    order = batches + batches[::-1]  # A-B-C-C-B-A
+    runs: dict[int, list[dict]] = {b: [] for b in batches}
+    for i, b in enumerate(order):
+        t0 = time.monotonic()
+        r = measure_one(b, args.capacity, args.steps_per_dispatch,
+                        args.dispatches, args.sample_chunk,
+                        args.peak_tflops)
+        r["order_pos"] = i
+        runs[b].append(r)
+        print(json.dumps(r), flush=True)
+        print(f"[{i + 1}/{len(order)}] batch {b}: "
+              f"{r['grad_steps_per_s']} steps/s, mfu {r['mfu']:.1%} "
+              f"({time.monotonic() - t0:.0f}s)", file=sys.stderr,
+              flush=True)
+    print("batch  steps/s(two runs)  samples/s  MFU", file=sys.stderr)
+    for b in batches:
+        two = runs[b]
+        print(f"{b:5}  {[r['grad_steps_per_s'] for r in two]}  "
+              f"{[r['samples_per_s'] for r in two]}  "
+              f"{[r['mfu'] for r in two]}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -190,3 +190,42 @@ def test_eval_max_frames_caps_episode_length():
     res = worker.run(1, max_frames=cfg.eval_max_frames)
     assert res is not None and res["episodes"] == 1
     assert steps["n"] <= cfg.eval_max_frames
+
+
+def test_eval_max_frames_counts_raw_frames():
+    """eval_max_frames is specified in RAW env frames; a frame-skipped
+    env consumes frame_skip raw frames per agent step, so the episode
+    loop must run max_frames/frame_skip steps — counting agent steps
+    against the raw budget made the cap 4x looser than documented and
+    blew the final-eval deadline on slow-link hosts (round 5)."""
+    from ape_x_dqn_tpu.configs import EnvConfig, get_config
+    from ape_x_dqn_tpu.runtime.evaluation import EvalWorker
+
+    cfg = get_config("pong").replace(
+        env=EnvConfig(id="catch", kind="synthetic_atari"))
+    steps = {"n": 0}
+
+    def query(obs):
+        steps["n"] += 1
+        return np.zeros(6, np.float32)
+
+    worker = EvalWorker(cfg, query)
+    # budgets chosen so the CAP ends the episode, not `done` (a catch
+    # episode under this policy runs ~110 agent steps naturally — a
+    # generous budget would pass even with the bug reverted):
+    # 80 raw frames / frame_skip 4 = exactly 20 agent steps
+    worker.run_episode(max_frames=80)
+    assert steps["n"] == 20, steps["n"]
+
+    # unskipped kinds count 1:1 (cartpole runs ~10 steps naturally;
+    # a 5-frame budget must stop it at exactly 5)
+    cfg2 = get_config("cartpole_smoke")
+    steps["n"] = 0
+
+    def query2(obs):
+        steps["n"] += 1
+        return np.zeros(2, np.float32)
+
+    w2 = EvalWorker(cfg2, query2)
+    w2.run_episode(max_frames=5)
+    assert steps["n"] == 5, steps["n"]
