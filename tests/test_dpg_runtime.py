@@ -249,3 +249,22 @@ def test_dpg_improves_pendulum():
     assert out["actor_errors"] == [] and out["loop_errors"] == []
     assert out["eval"] is not None
     assert out["eval"]["mean_return"] > -400, out["eval"]
+
+
+@pytest.mark.slow
+def test_dpg_improves_real_walker_stand():
+    """Rising return on REAL dm_control walker stand through the full
+    driver — the second real-physics domain (round-5 verdict item 7;
+    pendulum swingup is the first). Random-policy floor ~25-45; the
+    round-5 measured run reached final greedy eval 124.1 (3 episodes,
+    105-147) in ~24 min on this 1-core host, so the bar is set with
+    headroom below that but well clear of random."""
+    _require_dm_control()
+    cfg = _dpg_cfg(num_actors=2).replace(
+        env=EnvConfig(id="walker_stand", kind="control"),
+        total_env_frames=120_000)
+    driver = ApexDriver(cfg)
+    out = driver.run(max_grad_steps=10**9, wall_clock_limit_s=900)
+    assert out["actor_errors"] == [] and out["loop_errors"] == []
+    assert out["eval"] is not None
+    assert out["eval"]["mean_return"] > 90, out["eval"]
