@@ -102,8 +102,15 @@ class BatchedInferenceServer:
 
     # -- client side -------------------------------------------------------
 
-    def query(self, inputs: Any, timeout: float = 30.0) -> Any:
-        """Blocking single-item query. inputs: pytree WITHOUT batch dim."""
+    def query(self, inputs: Any, timeout: float = 60.0) -> Any:
+        """Blocking single-item query. inputs: pytree WITHOUT batch dim.
+
+        Default timeout 60s (round 5, was 30): on tunneled hosts the
+        device link occasionally stalls for tens of seconds; a 30s
+        timeout turned one such stall into a fleet-wide cascade
+        (actors exhausted restarts, the eval rotation died) in the
+        round-5 live rotation run. Genuine server death still surfaces
+        — just one stall-length later."""
         req = _Request(inputs)
         self._q.put(req)
         if not req.event.wait(timeout):
@@ -112,7 +119,7 @@ class BatchedInferenceServer:
             raise req.result
         return req.result
 
-    def query_batch(self, inputs: Any, n: int, timeout: float = 30.0) -> Any:
+    def query_batch(self, inputs: Any, n: int, timeout: float = 60.0) -> Any:
         """Blocking multi-item query: every leaf of `inputs` carries a
         leading [n] batch dim; the reply's leaves do too. One request
         per vector-actor step — K env observations ride one queue entry
@@ -135,7 +142,7 @@ class BatchedInferenceServer:
         unwarmed server's first trickle of batch-1 queries times actors
         out (observed live: actor restart on 'inference server did not
         reply' during startup). Intermediate pow2 buckets still compile
-        on first use, inside the 30s query timeout.
+        on first use, inside the 60s default query timeout.
 
         example_input: one request pytree WITHOUT the batch dim (content
         irrelevant; only shapes/dtypes feed the compile cache).

@@ -644,10 +644,22 @@ class ApexDriver:
                     worker = self._make_eval_worker(game=game)
                     eval_i += 1
                 t_eval = time.monotonic()
-                res, depth_max = run_eval_measured(
-                    worker, self.cfg.eval_episodes, self.server,
-                    stop_event=self.stop_event,
-                    max_frames=self.cfg.eval_max_frames)
+                try:
+                    res, depth_max = run_eval_measured(
+                        worker, self.cfg.eval_episodes, self.server,
+                        stop_event=self.stop_event,
+                        max_frames=self.cfg.eval_max_frames)
+                except TimeoutError as e:
+                    # a transient server stall must not kill the eval
+                    # thread for the rest of the run (a 57-game
+                    # rotation died 14 games in when one query timed
+                    # out — round-5 live rotation); log, skip this
+                    # rotation slot, keep rotating
+                    self.metrics.log(self._grad_steps_total,
+                                     eval_game=game or self.cfg.env.id,
+                                     eval_error=repr(e))
+                    next_at = (self._grad_steps_total // every + 1) * every
+                    continue
                 if res is None:  # cancelled mid-eval at shutdown
                     break
                 with self._lock:

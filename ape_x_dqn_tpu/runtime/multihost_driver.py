@@ -494,10 +494,21 @@ class MultihostApexDriver:
                     worker = self._make_eval_worker(game=game)
                     eval_i += 1
                 t_eval = time.monotonic()
-                res, depth_max = run_eval_measured(
-                    worker, self.cfg.eval_episodes, self.server,
-                    stop_event=self.stop_event,
-                    max_frames=self.cfg.eval_max_frames)
+                try:
+                    res, depth_max = run_eval_measured(
+                        worker, self.cfg.eval_episodes, self.server,
+                        stop_event=self.stop_event,
+                        max_frames=self.cfg.eval_max_frames)
+                except TimeoutError as e:
+                    # transient server stall: skip this rotation slot,
+                    # keep the eval thread alive (same guard as
+                    # ApexDriver._eval_loop — the round-5 live rotation
+                    # died 14 games in on one stalled query)
+                    self.metrics.log(self._grad_steps,
+                                     eval_game=game or self.cfg.env.id,
+                                     eval_error=repr(e))
+                    next_at = (self._grad_steps // every + 1) * every
+                    continue
                 if res is None:  # cancelled mid-eval at shutdown
                     break
                 with self._lock:
