@@ -371,8 +371,8 @@ class ApexDriver:
                 continue
             n = int(batch["priorities"].shape[0])
             self._ingest_one(batch, n)
-        # ship any staged full blocks plus the remainder (ragged add
-        # single-chip; dropped on the mesh, where shapes are static)
+        # ship any staged full blocks; the partial tail is dropped and
+        # counted (single-chip and mesh alike — see _flush_stage)
         self._flush_stage(force=True)
 
     def _ingest_one(self, batch: dict, n: int) -> None:
@@ -424,40 +424,38 @@ class ApexDriver:
             self._stage_n -= block
             self._add_block(take, block)
         if force and self._stage_n:
-            if self.is_dist:
-                # a partial [dp, B] block cannot be shipped (static mesh
-                # shapes) — count it as dropped, matching the lossy-
-                # tolerant transport semantics
-                if self._frame_mode:
-                    # count LIVE transitions (segments carry dead episode-
-                    # tail pads), and leave _frames_total alone: env-frame
-                    # counts ride ingest messages separately in frame mode
-                    # and those frames were genuinely consumed
-                    self._stage_dropped += int(sum(
-                        (np.asarray(b["next_off"]) > 0).sum()
-                        for b in self._stage))
-                elif self.family == "r2d2":
-                    # units are sequences; env frames also ride ingest
-                    # messages separately here, so _frames_total stays.
-                    # The drop stat is transition-denominated: seq_length
-                    # per sequence (an upper bound — overlapping
-                    # sequences double-count their shared steps)
-                    self._stage_dropped += (self._stage_n
-                                            * self.cfg.replay.seq_length)
-                else:
-                    # flat mode: 1 unit = 1 env frame, keep the frames
-                    # counter reconciled with what actually reached replay
-                    self._stage_dropped += self._stage_n
-                    with self._lock:
-                        self._frames_total -= self._stage_n
+            # the partial tail block is DROPPED (counted), single-chip
+            # and mesh alike, matching the lossy-tolerant transport
+            # semantics. Single-chip used to ship it as one ragged add,
+            # but that compiles a brand-new XLA graph (20-40s on TPU,
+            # tens of seconds on a busy CPU host) during DRIVER
+            # TEARDOWN to save under one block of transitions the
+            # learner is about to stop sampling anyway — and an
+            # in-teardown compile was on the stack of a rare LLVM
+            # segfault observed in the round-5 CI soak. Ape-X tolerates
+            # far larger losses at every actor crash.
+            if self._frame_mode:
+                # count LIVE transitions (segments carry dead episode-
+                # tail pads), and leave _frames_total alone: env-frame
+                # counts ride ingest messages separately in frame mode
+                # and those frames were genuinely consumed
+                self._stage_dropped += int(sum(
+                    (np.asarray(b["next_off"]) > 0).sum()
+                    for b in self._stage))
+            elif self.family == "r2d2":
+                # units are sequences; env frames also ride ingest
+                # messages separately here, so _frames_total stays.
+                # The drop stat is transition-denominated: seq_length
+                # per sequence (an upper bound — overlapping
+                # sequences double-count their shared steps)
+                self._stage_dropped += (self._stage_n
+                                        * self.cfg.replay.seq_length)
             else:
-                # single-chip shutdown: one ragged add is fine (a single
-                # extra compile at the end of the run, not per-batch)
-                fields = {
-                    k: np.concatenate(
-                        [np.asarray(b[k]) for b in self._stage])
-                    for k in self._item_keys + ("priorities",)}
-                self._add_block(fields, self._stage_n)
+                # flat mode: 1 unit = 1 env frame, keep the frames
+                # counter reconciled with what actually reached replay
+                self._stage_dropped += self._stage_n
+                with self._lock:
+                    self._frames_total -= self._stage_n
             self._stage = []
             self._stage_n = 0
 
