@@ -106,6 +106,17 @@ class LearnerConfig:
     # learner by an update round-trip. 1 = exact per-step semantics.
     # A/B'd on the real chip: PERF.md "K-batch sampling".
     sample_chunk: int = 1
+    # Double-buffered replay sampling (PERF.md "Ideas not yet taken",
+    # now "Prefetch A/B"): pipeline the learner cycle one dispatch deep
+    # so the NEXT macro-step's tree descent + frame gather overlaps the
+    # CURRENT macro-step's K grad-steps. The prefetched sample is drawn
+    # against priorities that predate the in-flight write-back — a
+    # one-dispatch staleness identical in kind to sample_chunk's
+    # within-chunk staleness and to the reference's async host-side
+    # replay server (its sampler always lags the learner by an update
+    # round-trip). Default off until an on-chip A/B clears the ±3-5%
+    # noise band (bench.py --prefetch-ab records both orders).
+    sample_prefetch: bool = False
     # Pacing: cap grad-steps at this multiple of ingested transitions
     # (None = free-run, the Ape-X default where the learner trains as
     # fast as the device allows). Bounds replay overfit when actors are

@@ -121,7 +121,8 @@ class _DistLearnerBase:
 
     # -- pure step ---------------------------------------------------------
 
-    def _sample_weighted(self, state: DistTrainState, sk, n_per_shard):
+    def _sample_weighted(self, replay_state: ReplayState, sk,
+                         n_per_shard):
         """Per-shard stratified sample of n_per_shard items + global IS
         weights over the [dp, n_per_shard] pool.
 
@@ -144,20 +145,26 @@ class _DistLearnerBase:
         round-robin ingest keeps masses balanced in expectation, so
         the effective prioritization tracks the single-tree recipe.
 
+        Takes the replay state alone (not the full train state): like
+        the single-chip replay's sample_state it reads only
+        storage/tree/size, so a prefetched call commutes with an
+        in-flight per-shard priority write-back (the double-buffering
+        contract, runtime/learner.py).
+
         Returns (items [dp, n, ...], idx [dp, n], w [dp, n]) with w
         NOT yet max-normalized (callers normalize per training batch).
         """
         def shard_sample(rstate: ReplayState, key):
             return self.replay.sample_items(rstate, key, n_per_shard)
 
-        items, idx, probs = jax.vmap(shard_sample)(state.replay, sk)
+        items, idx, probs = jax.vmap(shard_sample)(replay_state, sk)
         n_global = jnp.maximum(
-            state.replay.size.astype(jnp.float32).sum(), 1.0)
+            replay_state.size.astype(jnp.float32).sum(), 1.0)
         w = (n_global * jnp.maximum(probs / self.dp, 1e-12)
              ) ** (-self.replay.beta)
         # dead frame-ring pad slots (prob ~0) would dominate the max-
         # normalization; they train with weight 0 instead
-        w = w * jax.vmap(self.replay.valid_mask)(state.replay, idx)
+        w = w * jax.vmap(self.replay.valid_mask)(replay_state, idx)
         return items, idx, w
 
     def _flat(self, x):
@@ -191,9 +198,9 @@ class _DistLearnerBase:
 
     def _train_step(self, state: DistTrainState
                     ) -> tuple[DistTrainState, dict]:
-        keys = jax.vmap(lambda k: jax.random.split(k, 2))(state.rng)
-        rng, sk = keys[:, 0], keys[:, 1]
-        items, idx, w = self._sample_weighted(state, sk, self.b_local)
+        rng, sk = self._split_rng(state.rng)
+        items, idx, w = self._sample_weighted(state.replay, sk,
+                                              self.b_local)
         params, target_params, opt_state, step, td_shard, metrics = \
             self._sgd_step(state.params, state.target_params,
                            state.opt_state, state.step, items, w)
@@ -204,19 +211,16 @@ class _DistLearnerBase:
         return DistTrainState(params, target_params, opt_state, new_replay,
                               rng, step), metrics
 
-    def _train_step_k(self, state: DistTrainState,
-                      k: int) -> tuple[DistTrainState, dict]:
-        """K grad-steps from ONE per-shard stratified sample + ONE
-        priority write-back — the K-batch relaxation
-        (LearnerConfig.sample_chunk), dist form of
-        runtime/learner.py:DQNLearner._train_step_k; same staleness
-        semantics, same interleaved-strata chunking (chunk j takes
-        strata {j, j+K, ...} within every shard so each chunk spans
-        the full per-shard priority range), same static unrolled loop
-        (lax.scan conv bodies are pathologically slow on CPU)."""
-        keys = jax.vmap(lambda kk: jax.random.split(kk, 2))(state.rng)
-        rng, sk = keys[:, 0], keys[:, 1]
-        items, idx, w = self._sample_weighted(state, sk,
+    def _sample_stage(self, replay_state: ReplayState, sk, k: int):
+        """Pure SAMPLE stage of the split K-batch cycle, dist form of
+        runtime/learner.py:SingleChipLearner._sample_stage: one
+        per-shard stratified K*b_local descent + gather + global IS
+        weights, chunked for the K SGD steps.
+
+        -> (items_k [K, dp, b_local, ...], idx [dp, K*b_local]
+        UN-chunked for the per-shard write-back, w_k [K, dp, b_local]
+        raw — _sgd_step max-normalizes per training batch)."""
+        items, idx, w = self._sample_weighted(replay_state, sk,
                                               k * self.b_local)
 
         def chunked(x):
@@ -227,6 +231,15 @@ class _DistLearnerBase:
 
         items_k = jax.tree.map(chunked, items)
         w_k = chunked(w)
+        return items_k, idx, w_k
+
+    def _learn_stage(self, state: DistTrainState, sample,
+                     k: int) -> tuple[DistTrainState, dict]:
+        """Pure LEARN stage: K SGD steps over an already-drawn sample
+        + ONE vmapped per-shard write-back + target sync (static
+        unrolled loop — lax.scan conv bodies are pathologically slow
+        on CPU). `state.rng` must already be advanced past the draw."""
+        items_k, idx, w_k = sample
         params, target_params, opt_state, step = (
             state.params, state.target_params, state.opt_state,
             state.step)
@@ -245,7 +258,27 @@ class _DistLearnerBase:
             lambda rs, i, td: self.replay.update_priorities(rs, i, td)
         )(state.replay, idx, td_all)
         return DistTrainState(params, target_params, opt_state,
-                              new_replay, rng, step), metrics
+                              new_replay, state.rng, step), metrics
+
+    def _split_rng(self, rng):
+        """[dp] keys -> ([dp] advanced, [dp] subkeys)."""
+        keys = jax.vmap(lambda kk: jax.random.split(kk, 2))(rng)
+        return keys[:, 0], keys[:, 1]
+
+    def _train_step_k(self, state: DistTrainState,
+                      k: int) -> tuple[DistTrainState, dict]:
+        """K grad-steps from ONE per-shard stratified sample + ONE
+        priority write-back — the K-batch relaxation
+        (LearnerConfig.sample_chunk), dist form of
+        runtime/learner.py:DQNLearner._train_step_k; same staleness
+        semantics, same interleaved-strata chunking (chunk j takes
+        strata {j, j+K, ...} within every shard so each chunk spans
+        the full per-shard priority range). Composed from the split
+        _sample_stage/_learn_stage so the fused and double-buffered
+        paths cannot drift."""
+        rng, sk = self._split_rng(state.rng)
+        sample = self._sample_stage(state.replay, sk, k)
+        return self._learn_stage(state._replace(rng=rng), sample, k)
 
     # -- jitted endpoints --------------------------------------------------
 
@@ -258,15 +291,38 @@ class _DistLearnerBase:
         """Scan-free K-batch macro-step (see DQNLearner.train_step_k)."""
         return self._train_step_k(state, k)
 
+    @partial(jax.jit, static_argnums=(0, 2))
+    def sample_k(self, state: DistTrainState, k: int):
+        """Standalone SAMPLE dispatch (host-side double-buffering, see
+        SingleChipLearner.sample_k) — NOT donated; the caller still
+        owns `state` for the learn_k on the previous draw.
+        -> (sample, advanced [dp] rng)."""
+        rng, sk = self._split_rng(state.rng)
+        return self._sample_stage(state.replay, sk, k), rng
+
+    @partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+    def learn_k(self, state: DistTrainState, sample, k: int):
+        """Standalone LEARN dispatch on a sample drawn earlier by
+        sample_k (see SingleChipLearner.learn_k; sample not donated —
+        its buffers match no output shape)."""
+        return self._learn_stage(state, sample, k)
+
     @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
     def train_many(self, state: DistTrainState, n: int):
         """n grad-steps per dispatch; with sample_chunk=K>1 runs n//K
-        K-batch macro-steps plus exact singles for any remainder."""
+        K-batch macro-steps plus exact singles for any remainder; with
+        sample_prefetch the macro-steps run double-buffered (next
+        per-shard descent drawn before this macro-step's write-back —
+        see SingleChipLearner._train_many_prefetch for the staleness
+        contract)."""
         k = getattr(self.lcfg, "sample_chunk", 1)
 
         def body(s, _):
             s, m = self._train_step(s)
             return s, m
+
+        if getattr(self.lcfg, "sample_prefetch", False):
+            return self._train_many_prefetch(state, n, max(k, 1), body)
 
         if k <= 1:
             state, metrics = jax.lax.scan(body, state, None, length=n)
@@ -286,6 +342,34 @@ class _DistLearnerBase:
         if n // k:
             state, metrics = jax.lax.scan(body_k, state, None,
                                           length=n // k)
+        return state, jax.tree.map(lambda x: x[-1], metrics)
+
+    def _train_many_prefetch(self, state: DistTrainState, n: int,
+                             k: int, body):
+        """Dist mirror of SingleChipLearner._train_many_prefetch: the
+        scan body draws macro-step i+1's per-shard sample from the
+        shard trees BEFORE macro-step i's vmapped write-back, so XLA
+        overlaps the next descent/gather with the K SGD steps; one
+        macro-dispatch of priority staleness, prologue-fresh first
+        step, final prefetched sample discarded."""
+        metrics = None
+        if n % k:
+            state, metrics = jax.lax.scan(body, state, None,
+                                          length=n % k)
+        if n // k:
+            rng, sk = self._split_rng(state.rng)
+            pending = self._sample_stage(state.replay, sk, k)
+            state = state._replace(rng=rng)
+
+            def body_pf(carry, _):
+                s, pend = carry
+                rng, sk = self._split_rng(s.rng)
+                nxt = self._sample_stage(s.replay, sk, k)
+                s, m = self._learn_stage(s._replace(rng=rng), pend, k)
+                return (s, nxt), m
+
+            (state, _), metrics = jax.lax.scan(
+                body_pf, (state, pending), None, length=n // k)
         return state, jax.tree.map(lambda x: x[-1], metrics)
 
     @partial(jax.jit, static_argnums=0, donate_argnums=1)

@@ -63,20 +63,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.ops import sum_tree
-from ape_x_dqn_tpu.replay.packing import dus_rows, pad128
+from ape_x_dqn_tpu.replay.packing import dus_rows, frame_mode, pad128
 from ape_x_dqn_tpu.replay.prioritized import (PrioritizedReplay,
                                               ReplayState, ring_cursor,
                                               ring_finish)
 
 
-def frame_ring_mode(storage: str, obs_shape: tuple[int, ...]) -> bool:
-    """THE predicate for frame-segment storage in the flat-DQN family —
-    shared by runtime/family.py (layout selection) and utils/hbm.py
-    (budget pricing), mirroring replay/sequence.sequence_frame_mode so
-    the two can never drift: frame-ring applies to [H, W, stack] pixel
-    observations (the dtype requirement — uint8 — is enforced with a
-    ValueError at FrameRingReplay construction)."""
-    return storage == "frame_ring" and len(obs_shape) == 3
+# THE predicate for frame-segment storage in the flat-DQN family — an
+# alias of the ONE shared implementation in replay/packing.py
+# (sequence_frame_mode in replay/sequence.py is the same object), so
+# layout selection (runtime/family.py) and budget pricing
+# (utils/hbm.py) cannot drift from each other or from the sequence
+# layout. The uint8 dtype requirement is enforced with a ValueError at
+# FrameRingReplay construction.
+frame_ring_mode = frame_mode
 
 
 def frame_segment_spec(seg_transitions: int, n_step: int,

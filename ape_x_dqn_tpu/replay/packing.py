@@ -32,9 +32,18 @@ Two design rules eliminate both costs:
    by SKIP-TO-HEAD semantics: a block that would wrap is written at
    slot 0 instead, leaving the few tail slots holding their previous
    (still-consistent) items. When the block size divides the capacity
-   — every shipping ingest path; block sizes are fixed per staging
-   buffer — the wrap case never occurs and semantics are bit-identical
-   to the modular ring.
+   the wrap case never occurs and semantics are bit-identical to the
+   modular ring — which covers the frame-ring/segment ingest paths
+   (fixed-size segment blocks, capacity a multiple of the segment
+   size) but NOT every flat-transition path: the default
+   ActorConfig.ingest_batch=50 does not divide a power-of-two
+   capacity, and a shutdown flush ships whatever partial block
+   remains. For such non-dividing block sizes, every skip restarts
+   the cursor at slot 0 and up to block-1 tail slots are permanently
+   RETIRED: ring_write_size never counts them as filled, the sum-tree
+   never carries priority there, and sampling never returns them
+   (regression-tested in tests/test_packing.py with ingest_batch=50)
+   — a <= block/capacity capacity loss, not a correctness hazard.
 """
 
 from __future__ import annotations
@@ -56,6 +65,25 @@ U8_SUBLANE = 32
 def pad128(n: int) -> int:
     """Round up to the 128-byte lane tile."""
     return -(-int(n) // LANE) * LANE
+
+
+def frame_mode(storage: str, obs_shape: tuple[int, ...]) -> bool:
+    """THE single-frame-storage predicate — shared (aliased) by
+    replay/frame_ring.frame_ring_mode (flat-DQN segment layout) and
+    replay/sequence.sequence_frame_mode (R2D2 sequence layout), and
+    through them by runtime/family.py (layout selection) and
+    utils/hbm.py (budget pricing), so the selection and the pricing can
+    never drift: frame mode applies to [H, W, stack] pixel observations
+    under frame_ring storage, any dtype (the byte-row packing inside
+    the replay additionally engages only for uint8, but the item SHAPE
+    is the same either way; the frame-ring layout's uint8 requirement
+    is enforced with a ValueError at FrameRingReplay construction).
+
+    Defined here rather than in either layout module because packing
+    is the one module both already import — the two predicates used to
+    be byte-identical copies, each claiming to be "THE predicate", and
+    could drift exactly the way the claim promised they couldn't."""
+    return storage == "frame_ring" and len(obs_shape) == 3
 
 
 def ring_write_start(pos: jax.Array, block: int, capacity: int) -> jax.Array:

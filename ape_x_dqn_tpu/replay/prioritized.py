@@ -75,7 +75,7 @@ class PrioritizedReplay:
     """
 
     def __init__(self, capacity: int, alpha: float = 0.6, beta: float = 0.4,
-                 eps: float = 1e-6):
+                 eps: float = 1e-6, item_spec: Any = None):
         assert capacity > 0 and (capacity & (capacity - 1)) == 0, \
             "capacity must be a power of two"
         self.capacity = capacity
@@ -83,14 +83,34 @@ class PrioritizedReplay:
         self.beta = beta
         self.eps = eps
         self._packer: PixelPacker | None = None
+        self._storage_spec: Any = None
+        # packer construction is DETERMINISTIC: given a spec here, the
+        # codec exists from construction — encode/decode behavior no
+        # longer depends on whether init() happened to run first (the
+        # hidden side effect a replay shared across restore paths could
+        # otherwise observe mid-flight)
+        if item_spec is not None:
+            self._build_packer(item_spec)
+
+    def _build_packer(self, item_spec: Any) -> None:
+        self._packer, self._storage_spec = make_packer(item_spec)
 
     # -- state construction ------------------------------------------------
 
-    def init(self, item_spec: Any) -> ReplayState:
-        """item_spec: pytree of ShapeDtypeStruct (or arrays) for ONE item."""
-        self._packer, spec = make_packer(item_spec)
+    def init(self, item_spec: Any = None) -> ReplayState:
+        """item_spec: pytree of ShapeDtypeStruct (or arrays) for ONE
+        item. Optional when the constructor already received it; calling
+        with neither raises instead of silently building packer-less
+        storage."""
+        if item_spec is not None:
+            self._build_packer(item_spec)
+        if self._storage_spec is None:
+            raise ValueError(
+                "PrioritizedReplay has no item spec — pass item_spec to "
+                "the constructor or to init()")
         storage = jax.tree.map(
-            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype), spec)
+            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype),
+            self._storage_spec)
         return ReplayState(
             storage=storage, tree=sum_tree.init(self.capacity),
             pos=jnp.int32(0), size=jnp.int32(0))
@@ -187,6 +207,28 @@ class PrioritizedReplay:
         slots; the frame-ring layout overrides this (pad slots)."""
         return jnp.ones(idx.shape, jnp.float32)
 
+    # -- split entry points (double-buffered learner pipeline) -------------
+
+    def sample_state(self, state: ReplayState, rng: jax.Array, batch: int
+                     ) -> tuple[Any, jax.Array, jax.Array]:
+        """SAMPLE half of the split learner cycle — `sample` under its
+        pipeline-contract name. Reads only storage, tree, and size
+        (never the write cursor `pos`), so a prefetched draw commutes
+        with a concurrent `update_state` write-back: the draw simply
+        sees the pre-write-back priorities, the one-dispatch staleness
+        the double-buffered train_many accepts by design. Subclasses
+        override sample/sample_items, not this delegator, so every
+        storage layout inherits the contract."""
+        return self.sample(state, rng, batch)
+
+    def update_state(self, state: ReplayState, idx: jax.Array,
+                     td_abs: jax.Array) -> ReplayState:
+        """UPDATE half of the split learner cycle — `update_priorities`
+        under its pipeline-contract name. Writes ONLY the sum-tree
+        (storage/pos/size pass through untouched), which is what makes
+        it safe to reorder against a prefetched sample_state draw."""
+        return self.update_priorities(state, idx, td_abs)
+
     # -- convenience jitted endpoints (standalone use / replay server) -----
 
     @partial(jax.jit, static_argnums=0, donate_argnums=1)
@@ -208,15 +250,27 @@ class UniformReplayDevice:
     Sampling is uniform over filled slots; IS weights are all ones.
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, item_spec: Any = None):
         assert capacity > 0 and (capacity & (capacity - 1)) == 0
         self.capacity = capacity
         self._packer: PixelPacker | None = None
+        self._storage_spec: Any = None
+        if item_spec is not None:  # deterministic, like PrioritizedReplay
+            self._build_packer(item_spec)
 
-    def init(self, item_spec: Any) -> ReplayState:
-        self._packer, spec = make_packer(item_spec)
+    def _build_packer(self, item_spec: Any) -> None:
+        self._packer, self._storage_spec = make_packer(item_spec)
+
+    def init(self, item_spec: Any = None) -> ReplayState:
+        if item_spec is not None:
+            self._build_packer(item_spec)
+        if self._storage_spec is None:
+            raise ValueError(
+                "UniformReplayDevice has no item spec — pass item_spec "
+                "to the constructor or to init()")
         storage = jax.tree.map(
-            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype), spec)
+            lambda s: jnp.zeros((self.capacity, *s.shape), s.dtype),
+            self._storage_spec)
         return ReplayState(storage=storage,
                            tree=jnp.zeros(1, jnp.float32),  # unused
                            pos=jnp.int32(0), size=jnp.int32(0))
@@ -244,3 +298,11 @@ class UniformReplayDevice:
 
     def update_priorities(self, state: ReplayState, idx, td_abs):
         return state
+
+    # split entry points (see PrioritizedReplay): sampling is uniform
+    # and updates are no-ops, so the commuting contract holds trivially
+    def sample_state(self, state: ReplayState, rng: jax.Array, batch: int):
+        return self.sample(state, rng, batch)
+
+    def update_state(self, state: ReplayState, idx, td_abs):
+        return self.update_priorities(state, idx, td_abs)

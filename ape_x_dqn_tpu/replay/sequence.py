@@ -17,15 +17,15 @@ from typing import Any
 
 import numpy as np
 
+from ape_x_dqn_tpu.replay.packing import frame_mode
 
-def sequence_frame_mode(storage: str, obs_shape: tuple[int, ...]) -> bool:
-    """THE predicate for single-frame sequence storage — shared by
-    runtime/family.py (layout selection) and utils/hbm.py (budget
-    pricing) so the two can never drift: frame mode applies to
-    [H, W, stack] pixel observations under frame_ring storage, any
-    dtype (the byte-row packing inside the replay additionally engages
-    only for uint8, but the item SHAPE is the same either way)."""
-    return storage == "frame_ring" and len(obs_shape) == 3
+
+# THE predicate for single-frame sequence storage — an alias of the
+# ONE shared implementation in replay/packing.py (frame_ring_mode in
+# replay/frame_ring.py is the same object), so layout selection
+# (runtime/family.py) and budget pricing (utils/hbm.py) cannot drift
+# from each other or from the flat-DQN segment layout.
+sequence_frame_mode = frame_mode
 
 
 def sequence_item_spec(obs_shape: tuple[int, ...], obs_dtype,

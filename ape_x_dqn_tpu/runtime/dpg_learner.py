@@ -59,6 +59,13 @@ class DPGLearner:
             raise ValueError(
                 "learner.sample_chunk > 1 is not implemented by the "
                 "DPG learner — set sample_chunk=1")
+        if getattr(lcfg, "sample_prefetch", False):
+            # same rule for the double-buffered sampling pipeline: this
+            # learner's fused train step has no split sample/learn
+            # stages to pipeline
+            raise ValueError(
+                "learner.sample_prefetch is not implemented by the "
+                "DPG learner — set sample_prefetch=False")
         self.actor_apply = actor_apply
         self.critic_apply = critic_apply
         self.replay = replay

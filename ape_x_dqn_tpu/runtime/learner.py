@@ -67,6 +67,13 @@ class SingleChipLearner:
     loss). The K-batch semantics (interleaved strata, per-chunk IS
     renorm, one write-back, remainder-first metrics) therefore cannot
     drift between the flat-DQN and sequence learners.
+
+    The K-batch cycle itself is split into two pure stages —
+    _sample_stage (stratified K*B descent + gather + chunked IS
+    weights) and _learn_stage (K SGD steps + one write-back + target
+    sync) — composed back-to-back by the fused path and pipelined
+    one-deep by the double-buffered path (sample_prefetch), which both
+    the sequence and dist learners inherit.
     """
 
     # -- state ------------------------------------------------------------
@@ -102,27 +109,20 @@ class SingleChipLearner:
                                replay_state, rng, step)
         return new_state, metrics
 
-    def _train_step_k(self, state: TrainState,
-                      k: int) -> tuple[TrainState, dict]:
-        """K grad-steps from ONE stratified sample + ONE priority
-        write-back (the K-batch relaxation, LearnerConfig.sample_chunk).
+    def _sample_stage(self, replay_state: ReplayState, sk: jax.Array,
+                      k: int):
+        """Pure SAMPLE stage of the (split) K-batch cycle: one
+        stratified K*B tree descent + storage gather + IS weights,
+        already chunked for the K SGD steps. Reads only the replay
+        state (via `replay.sample_state`, which never touches the write
+        cursor), so a prefetched call commutes with an in-flight
+        priority write-back — the double-buffering contract.
 
-        Chunk j+1 trains on priorities that predate chunk j's TD errors
-        — the same staleness the reference's async host-side replay
-        server exhibits between its sampler and learner. The payoff:
-        the K SGD steps carry no tree dependency between them, so XLA
-        overlaps the single big descent/gather/write-back with K steps
-        of MXU work instead of serializing tree<->loss every step.
-
-        The K chunks run as a STATIC unrolled loop, not lax.scan: K is
-        small (4-8) and measured on CPU a scanned conv body ran ~17x
-        slower than the identical straight-line code (855 vs 51
-        ms/step — scan's carried buffers defeat in-place aliasing
-        there), while unrolled code also gives XLA's scheduler the
-        whole window to overlap."""
+        -> (items_k [K, B, ...] pytree, idx_k [K, B], is_w_k [K, B])
+        """
         b = self.lcfg.batch_size
-        rng, sk = jax.random.split(state.rng)
-        items, idx, is_w = self.replay.sample(state.replay, sk, k * b)
+        items, idx, is_w = self.replay.sample_state(replay_state, sk,
+                                                    k * b)
 
         # stratum i of the K*B descent covers cumulative-mass slice
         # [i, i+1)/(K*B) over leaves in ring-insertion order, so chunk
@@ -140,7 +140,22 @@ class SingleChipLearner:
         is_w_k = chunked(is_w)
         is_w_k = is_w_k / jnp.maximum(
             is_w_k.max(axis=1, keepdims=True), 1e-12)
+        return items_k, idx_k, is_w_k
 
+    def _learn_stage(self, state: TrainState, sample,
+                     k: int) -> tuple[TrainState, dict]:
+        """Pure LEARN stage: K SGD steps over an already-drawn sample
+        + ONE priority write-back + target sync. `state.rng` must
+        already be advanced past the draw that produced `sample`.
+
+        The K chunks run as a STATIC unrolled loop, not lax.scan: K is
+        small (4-8) and measured on CPU a scanned conv body ran ~17x
+        slower than the identical straight-line code (855 vs 51
+        ms/step — scan's carried buffers defeat in-place aliasing
+        there), while unrolled code also gives XLA's scheduler the
+        whole window to overlap."""
+        b = self.lcfg.batch_size
+        items_k, idx_k, is_w_k = sample
         params, target_params, opt_state, step = (
             state.params, state.target_params, state.opt_state,
             state.step)
@@ -154,12 +169,31 @@ class SingleChipLearner:
             td_parts.append(td_abs)
         # td_parts[j] pairs with idx_k[j] (chunk order), so flatten
         # idx_k the same way for the single write-back
-        replay_state = self.replay.update_priorities(
+        replay_state = self.replay.update_state(
             state.replay, idx_k.reshape(k * b),
             jnp.concatenate(td_parts))
         new_state = TrainState(params, target_params, opt_state,
-                               replay_state, rng, step)
+                               replay_state, state.rng, step)
         return new_state, metrics
+
+    def _train_step_k(self, state: TrainState,
+                      k: int) -> tuple[TrainState, dict]:
+        """K grad-steps from ONE stratified sample + ONE priority
+        write-back (the K-batch relaxation, LearnerConfig.sample_chunk).
+
+        Chunk j+1 trains on priorities that predate chunk j's TD errors
+        — the same staleness the reference's async host-side replay
+        server exhibits between its sampler and learner. The payoff:
+        the K SGD steps carry no tree dependency between them, so XLA
+        overlaps the single big descent/gather/write-back with K steps
+        of MXU work instead of serializing tree<->loss every step.
+
+        Composed from the split _sample_stage/_learn_stage so the fused
+        path and the double-buffered path (sample_prefetch) cannot
+        drift."""
+        rng, sk = jax.random.split(state.rng)
+        sample = self._sample_stage(state.replay, sk, k)
+        return self._learn_stage(state._replace(rng=rng), sample, k)
 
     # -- jitted endpoints --------------------------------------------------
 
@@ -177,17 +211,42 @@ class SingleChipLearner:
         (the single-process driver uses it for the K-batch path)."""
         return self._train_step_k(state, k)
 
+    @partial(jax.jit, static_argnums=(0, 2))
+    def sample_k(self, state: TrainState, k: int):
+        """Standalone SAMPLE dispatch for the host-side double-buffer
+        pipeline (single_process.py): draw the NEXT macro-step's
+        chunked sample from the current tree. Deliberately NOT donated
+        — the caller keeps `state` alive for the learn_k that trains on
+        the PREVIOUS draw. -> (sample, advanced rng)."""
+        rng, sk = jax.random.split(state.rng)
+        return self._sample_stage(state.replay, sk, k), rng
+
+    @partial(jax.jit, static_argnums=(0, 3), donate_argnums=(1,))
+    def learn_k(self, state: TrainState, sample, k: int):
+        """Standalone LEARN dispatch: K SGD steps + write-back on a
+        sample drawn earlier by sample_k (possibly against a tree that
+        an `add` or a previous write-back has since changed — the
+        accepted async-replay staleness). state.rng must be the rng
+        sample_k returned. Only the state is donated — the sample's
+        buffers match no output shape (XLA would warn them unusable)
+        and are freed when the caller drops its reference."""
+        return self._learn_stage(state, sample, k)
+
     @partial(jax.jit, static_argnums=(0, 2), donate_argnums=1)
     def train_many(self, state: TrainState, n: int):
         """n grad-steps in one dispatch via lax.scan (bench hot path).
         With sample_chunk=K>1, runs n//K K-batch macro-steps (plus
         exact single steps for any remainder) — same grad-step count
-        either way."""
+        either way. With sample_prefetch, the macro-step scan runs
+        double-buffered (see _train_many_prefetch)."""
         k = getattr(self.lcfg, "sample_chunk", 1)
 
         def body(s, _):
             s, m = self._train_step(s)
             return s, m
+
+        if getattr(self.lcfg, "sample_prefetch", False):
+            return self._train_many_prefetch(state, n, max(k, 1), body)
 
         if k <= 1:
             state, metrics = jax.lax.scan(body, state, None, length=n)
@@ -209,6 +268,48 @@ class SingleChipLearner:
         if n // k:
             state, metrics = jax.lax.scan(body_k, state, None,
                                           length=n // k)
+        return state, jax.tree.map(lambda x: x[-1], metrics)
+
+    def _train_many_prefetch(self, state: TrainState, n: int, k: int,
+                             body):
+        """Double-buffered macro-step pipeline (the tentpole,
+        LearnerConfig.sample_prefetch): inside the scan body, the NEXT
+        macro-step's sample is drawn from the tree BEFORE this
+        macro-step's K SGD steps and priority write-back run. The draw
+        and the SGD/write-back then share no data dependency, so XLA's
+        scheduler is free to overlap the next tree descent + storage
+        gather with the current backward passes — the overlap the fused
+        body only achieves within one macro-step.
+
+        Staleness contract: the sample for macro-step i+1 sees
+        priorities that predate macro-step i's write-back — one
+        dispatch of lag, the same kind the K-batch relaxation already
+        accepts within a macro-step and the reference's async
+        host-side sampler exhibits always. The first macro-step trains
+        on a fresh (prologue) draw, so a single-macro-step dispatch is
+        bit-identical in params to train_step_k; the final prefetched
+        sample is discarded (one extra K*B descent per dispatch,
+        amortized over n//k macro-steps)."""
+        metrics = None
+        if n % k:
+            state, metrics = jax.lax.scan(body, state, None,
+                                          length=n % k)
+        if n // k:
+            rng, sk = jax.random.split(state.rng)
+            pending = self._sample_stage(state.replay, sk, k)
+            state = state._replace(rng=rng)
+
+            def body_pf(carry, _):
+                s, pend = carry
+                rng, sk = jax.random.split(s.rng)
+                # drawn BEFORE _learn_stage's write-back: no data
+                # dependency with the K SGD steps below
+                nxt = self._sample_stage(s.replay, sk, k)
+                s, m = self._learn_stage(s._replace(rng=rng), pend, k)
+                return (s, nxt), m
+
+            (state, _), metrics = jax.lax.scan(
+                body_pf, (state, pending), None, length=n // k)
         return state, jax.tree.map(lambda x: x[-1], metrics)
 
     @partial(jax.jit, static_argnums=0, donate_argnums=1)

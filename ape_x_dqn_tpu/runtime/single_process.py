@@ -77,6 +77,19 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
     # tests/test_e2e_catch.py::test_cnn_learns_catch_kbatch)
     sample_chunk = max(getattr(cfg.learner, "sample_chunk", 1), 1)
     train_bank = 0
+    # Double-buffered sampling (LearnerConfig.sample_prefetch): the
+    # host keeps ONE macro-step's sample in flight — each macro
+    # opportunity first dispatches sample_k against the CURRENT tree,
+    # then learn_k on the sample drawn at the PREVIOUS opportunity, so
+    # the descent/gather dispatch can overlap the previous dispatch's
+    # SGD work on device. The pending sample's priorities (and, after
+    # interleaved adds, even its slots) may be one round stale — the
+    # async-replay semantics the reference's host-side sampler always
+    # has, parity-tested on the catch e2e
+    # (tests/test_e2e_catch.py::test_cnn_learns_catch_prefetch).
+    sample_prefetch = (sample_chunk > 1
+                       and getattr(cfg.learner, "sample_prefetch", False))
+    pending_sample = None
     eps_final = 0.05
     eps_decay_frames = max(total // 10, 1_000)
 
@@ -127,7 +140,19 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
                 train_bank += 1
                 if train_bank >= sample_chunk:
                     train_bank = 0
-                    state, m = learner.train_step_k(state, sample_chunk)
+                    if sample_prefetch:
+                        if pending_sample is None:  # pipeline prologue
+                            pending_sample, rng2 = learner.sample_k(
+                                state, sample_chunk)
+                            state = state._replace(rng=rng2)
+                        nxt, rng2 = learner.sample_k(state, sample_chunk)
+                        state, m = learner.learn_k(
+                            state._replace(rng=rng2), pending_sample,
+                            sample_chunk)
+                        pending_sample = nxt
+                    else:
+                        state, m = learner.train_step_k(state,
+                                                        sample_chunk)
                     grad_steps += sample_chunk
             else:
                 state, m = learner.train_step(state)

@@ -8,15 +8,18 @@ and Ape-X regenerates them — actors refill the buffer on resume).
 ``tests/test_checkpoint.py`` asserts the round-trip is bitwise and that a
 resumed run continues the grad-step counter.
 
-FORMAT BREAK (round 5): replay-bearing checkpoints
-(``RunConfig.checkpoint_replay=True``) written before the byte-row
-storage layout (replay/packing.py — frames [S*F, pad128(H*W)] instead
-of [S*F, H, W] planes, packed pixel obs rows in flat storage) do not
-restore into the new layout: the Orbax template mirrors the CURRENT
-storage shapes and the restore fails with a structure mismatch at
-startup. Param-only checkpoints (the default) are unaffected. Restart
-replay-bearing runs fresh, or restore on the old code and re-save
-params-only.
+STORAGE LAYOUT VERSIONING (round 5 FORMAT BREAK, now machine-checked):
+replay-bearing checkpoints (``RunConfig.checkpoint_replay=True``)
+written before the byte-row storage layout (replay/packing.py — frames
+[S*F, pad128(H*W)] instead of [S*F, H, W] planes, packed pixel obs rows
+in flat storage) do not restore into the new layout. Every dict payload
+saved here is therefore stamped with ``STORAGE_LAYOUT_VERSION``; a
+restore that hits a version mismatch — or the Orbax structure mismatch
+an unstamped pre-versioning checkpoint produces — fails with a
+RuntimeError carrying the documented recovery guidance instead of a raw
+Orbax traceback: restart the run fresh, or restore on the old code and
+re-save a params-only checkpoint. Param-only checkpoints (the default)
+are unaffected by layout breaks either way.
 """
 
 from __future__ import annotations
@@ -24,7 +27,20 @@ from __future__ import annotations
 import os
 from typing import Any
 
+import numpy as np
 import orbax.checkpoint as ocp
+
+# Bump on any break in the on-disk layout of checkpointed device state
+# (storage byte-rows, ReplayState fields, ...). v2 = the round-5
+# byte-row packing layout.
+STORAGE_LAYOUT_VERSION = 2
+_LAYOUT_KEY = "storage_layout_version"
+
+_LAYOUT_GUIDANCE = (
+    "this checkpoint was written under an incompatible storage layout "
+    "(see utils/checkpoint.py STORAGE LAYOUT VERSIONING). Either restart "
+    "the run fresh, or restore the checkpoint on the code version that "
+    "wrote it and re-save params-only (checkpoint_replay=False)")
 
 
 class CheckpointManager:
@@ -37,6 +53,12 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
+        if isinstance(state, dict) and _LAYOUT_KEY not in state:
+            # stamp rides inside the payload so it survives any orbax
+            # version / directory relocation the metadata might not
+            state = {**state,
+                     _LAYOUT_KEY: np.asarray(STORAGE_LAYOUT_VERSION,
+                                             np.int32)}
         self._mngr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mngr.wait_until_finished()
@@ -45,20 +67,51 @@ class CheckpointManager:
         step = self.latest_step() if step is None else step
         if step is None:
             return None
-        if template is not None:
-            return self._mngr.restore(
-                step, args=ocp.args.StandardRestore(template))
-        return self._mngr.restore(step)
+        if isinstance(template, dict) and _LAYOUT_KEY not in template:
+            saved = self._raw_item_keys(step)
+            if saved is not None and _LAYOUT_KEY in saved:
+                # match the stamped payload; checked + stripped below so
+                # callers (driver template building) never see the key
+                template = {**template,
+                            _LAYOUT_KEY: np.asarray(0, np.int32)}
+        try:
+            if template is not None:
+                out = self._mngr.restore(
+                    step, args=ocp.args.StandardRestore(template))
+            else:
+                out = self._mngr.restore(step)
+        except (ValueError, KeyError, TypeError) as e:
+            # the raw Orbax structure-mismatch traceback names neither
+            # the cause nor the way out; translate it
+            raise RuntimeError(
+                f"checkpoint restore failed at step {step} with a "
+                f"structure mismatch ({e!s:.300}) — most likely "
+                + _LAYOUT_GUIDANCE) from e
+        if isinstance(out, dict) and _LAYOUT_KEY in out:
+            ver = int(np.asarray(out.pop(_LAYOUT_KEY)))
+            if ver != STORAGE_LAYOUT_VERSION:
+                raise RuntimeError(
+                    f"checkpoint storage layout v{ver} does not match "
+                    f"this code's v{STORAGE_LAYOUT_VERSION} — "
+                    + _LAYOUT_GUIDANCE)
+        return out
 
     def latest_step(self) -> int | None:
         return self._mngr.latest_step()
 
     def item_keys(self, step: int | None = None) -> set[str] | None:
-        """Top-level keys of a saved checkpoint's pytree, or None when
-        unknowable. Lets a restore build its template from what was
-        actually SAVED — e.g. toggling RunConfig.checkpoint_replay
-        between runs must not brick resume with an Orbax structure
-        mismatch (the flag governs saves; restores follow the file)."""
+        """Top-level keys of a saved checkpoint's pytree (version stamp
+        excluded), or None when unknowable. Lets a restore build its
+        template from what was actually SAVED — e.g. toggling
+        RunConfig.checkpoint_replay between runs must not brick resume
+        with an Orbax structure mismatch (the flag governs saves;
+        restores follow the file)."""
+        keys = self._raw_item_keys(step)
+        if keys is not None:
+            keys.discard(_LAYOUT_KEY)
+        return keys
+
+    def _raw_item_keys(self, step: int | None = None) -> set[str] | None:
         step = self.latest_step() if step is None else step
         if step is None:
             return None

@@ -38,13 +38,17 @@ def spread(runs) -> dict:
     made round-over-round deltas uninterpretable (round-3 verdict weak
     #1 — a −66% ingest 'regression' that was probably tunnel
     contention, unprovable without spread)."""
-    return {"median": round(float(np.median(runs)), 1),
-            "min": round(float(np.min(runs)), 1),
-            "max": round(float(np.max(runs)), 1)}
+    # 4 significant digits, not 1 decimal: CPU-host rates sit around
+    # 1 step/s where a fixed .1 rounding would eat a 5% A/B delta
+    def r(x):
+        return float(f"{float(x):.4g}")
+    return {"median": r(np.median(runs)),
+            "min": r(np.min(runs)),
+            "max": r(np.max(runs))}
 
 
 def build_learner(capacity: int, batch_size: int, storage: str,
-                  sample_chunk: int = 1):
+                  sample_chunk: int = 1, sample_prefetch: bool = False):
     from ape_x_dqn_tpu.configs import LearnerConfig, NetworkConfig
     from ape_x_dqn_tpu.envs.base import EnvSpec
     from ape_x_dqn_tpu.models import build_network
@@ -73,7 +77,8 @@ def build_learner(capacity: int, batch_size: int, storage: str,
     net = build_network(NetworkConfig(kind="nature_cnn", dueling=True), spec)
     params = net.init(component_key(0, "net_init"),
                       jnp.zeros((1, 84, 84, 4), jnp.uint8))
-    lcfg = LearnerConfig(batch_size=batch_size, sample_chunk=sample_chunk)
+    lcfg = LearnerConfig(batch_size=batch_size, sample_chunk=sample_chunk,
+                         sample_prefetch=sample_prefetch)
     if storage == "frame_ring":
         replay = FrameRingReplay(capacity=capacity, seg_transitions=16,
                                  n_step=3, obs_shape=spec.obs_shape)
@@ -365,6 +370,104 @@ def bench_actor_pipeline(num_actors: int = 2, envs_per_actor: int = 16,
     }
 
 
+def _build_seq_learner(batch_size: int, sample_chunk: int,
+                       sample_prefetch: bool, capacity: int = 4096,
+                       lstm: int = 64, seq_len: int = 16,
+                       obs_dim: int = 16):
+    """Small vector-obs R2D2 SequenceLearner + filled replay for the
+    prefetch A/B (the recurrent family has the deepest sample stage —
+    stored-state sequence gather — so it is where descent/backward
+    overlap has the most to hide behind)."""
+    from ape_x_dqn_tpu.configs import LearnerConfig, ReplayConfig
+    from ape_x_dqn_tpu.models import ApeXLSTMQNet
+    from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+    from ape_x_dqn_tpu.replay.sequence import sequence_item_spec
+    from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
+    from ape_x_dqn_tpu.utils.rng import component_key
+
+    net = ApeXLSTMQNet(num_actions=18, lstm_size=lstm, dense=lstm,
+                       compute_dtype="float32", mlp_torso=True)
+    z = jnp.zeros((1, lstm), jnp.float32)
+    params = net.init(component_key(0, "seq_net"),
+                      jnp.zeros((1, seq_len, obs_dim), jnp.float32), (z, z))
+    lcfg = LearnerConfig(batch_size=batch_size, n_step=2,
+                         value_rescale=True, sample_chunk=sample_chunk,
+                         sample_prefetch=sample_prefetch)
+    rcfg = ReplayConfig(kind="sequence", seq_length=seq_len, burn_in=4)
+    replay = PrioritizedReplay(capacity=capacity)
+    spec = sequence_item_spec((obs_dim,), np.float32, seq_len, lstm)
+    learner = SequenceLearner(lambda p, o, s: net.apply(p, o, s),
+                              replay, lcfg, rcfg)
+    state = learner.init(params, replay.init(spec),
+                         component_key(0, "seq_learner"))
+    rng = np.random.default_rng(0)
+    n = capacity
+    items = {
+        "obs": jnp.asarray(rng.normal(size=(n, seq_len, obs_dim)),
+                           jnp.float32),
+        "actions": jnp.asarray(rng.integers(0, 18, (n, seq_len)),
+                               jnp.int32),
+        "rewards": jnp.asarray(rng.normal(size=(n, seq_len)), jnp.float32),
+        "terminals": jnp.zeros((n, seq_len), jnp.float32),
+        "mask": jnp.ones((n, seq_len), jnp.float32),
+        "init_c": jnp.zeros((n, lstm), jnp.float32),
+        "init_h": jnp.zeros((n, lstm), jnp.float32),
+    }
+    state = learner.add(state, items,
+                        jnp.asarray(rng.random(n) + 0.1, jnp.float32))
+    return learner, state
+
+
+def bench_prefetch_ab(args) -> dict:
+    """A/B for the double-buffered sampler (LearnerConfig.
+    sample_prefetch): per family (flat DQN + R2D2 sequence), measure
+    grad-steps/s with prefetch OFF and ON, in BOTH orders (off->on then
+    on->off on fresh learners) so a drift artifact in either direction
+    is visible, median-of-`repeats` per arm. The adoption bar for
+    flipping a preset default is a win outside the noise band in both
+    orders (PERF.md 'Prefetch A/B')."""
+    spd, disp = args.ab_steps_per_dispatch, args.ab_dispatches
+
+    def flat_arm(prefetch: bool) -> list[float]:
+        _, learner, state, _spec = build_learner(
+            args.ab_capacity, args.ab_batch_size, args.storage,
+            args.sample_chunk, sample_prefetch=prefetch)
+        state, _ = prefill(learner, state, _spec,
+                           max(args.ab_capacity // 2, 4096), args.storage,
+                           repeats=1)
+        rates, _ = bench_learner(learner, state, spd, disp,
+                                 repeats=args.repeats)
+        return rates
+
+    def seq_arm(prefetch: bool) -> list[float]:
+        learner, state = _build_seq_learner(
+            args.ab_batch_size, args.sample_chunk, prefetch)
+        rates, _ = bench_learner(learner, state, spd, disp,
+                                 repeats=args.repeats)
+        return rates
+
+    out = {"sample_chunk": args.sample_chunk,
+           "batch_size": args.ab_batch_size,
+           "steps_per_dispatch": spd}
+    for name, arm in (("flat", flat_arm), ("sequence", seq_arm)):
+        orders = {}
+        for order in ("off_first", "on_first"):
+            first = order == "off_first"
+            a = arm(not first)   # off when off_first
+            b = arm(first)       # on when off_first
+            off, on = (a, b) if first else (b, a)
+            orders[order] = {"off": spread(off), "on": spread(on)}
+            log(f"prefetch A/B [{name}/{order}]: off "
+                f"{spread(off)} vs on {spread(on)} grad-steps/s")
+        d = [100.0 * (orders[o]["on"]["median"] / orders[o]["off"]["median"]
+                      - 1.0) for o in orders]
+        out[name] = {**orders,
+                     "on_vs_off_pct": [round(x, 1) for x in d]}
+        log(f"prefetch A/B [{name}]: on vs off "
+            f"{[f'{x:+.1f}%' for x in d]} (order off-first, on-first)")
+    return out
+
+
 def bench_h2d(mb: int = 64, repeats: int = 3, iters: int = 4) -> list[float]:
     """Raw host->device link bandwidth: pure `device_put` MB/s of a
     pinned 64MB buffer, no compute. Round-4 verdict weak #1: the ingest
@@ -438,12 +541,39 @@ def main() -> None:
                    "= the shipping flagship presets (PERF.md 'K-batch "
                    "sampling'); 1 = exact per-step semantics "
                    "(measures ~3-5% lower)")
+    p.add_argument("--prefetch-ab", action="store_true",
+                   help="run the double-buffered-sampler A/B "
+                   "(LearnerConfig.sample_prefetch off vs on, both "
+                   "orders, median-of-`--repeats` per arm) for the "
+                   "flat DQN AND R2D2 sequence families, recorded "
+                   "under secondary.prefetch_ab (PERF.md 'Prefetch "
+                   "A/B'). Runs at the --ab-* shapes, INSTEAD of the "
+                   "main flagship bench (the stdout metric is then "
+                   "the flat off-arm median)")
+    p.add_argument("--ab-batch-size", type=int, default=64,
+                   help="batch size for the prefetch A/B arms (small "
+                   "enough to iterate on a CPU host; raise on a real "
+                   "chip)")
+    p.add_argument("--ab-capacity", type=int, default=1 << 14)
+    p.add_argument("--ab-steps-per-dispatch", type=int, default=32)
+    p.add_argument("--ab-dispatches", type=int, default=4)
     p.add_argument("--peak-tflops", type=float, default=197.0,
                    help="chip peak bf16 TFLOP/s for the MFU estimate "
                    "(v5e-class default)")
     args = p.parse_args()
 
     log(f"devices: {jax.devices()}")
+    if args.prefetch_ab:
+        ab = bench_prefetch_ab(args)
+        gsps = ab["flat"]["off_first"]["off"]["median"]
+        print(json.dumps({
+            "metric": "learner_grad_steps_per_s",
+            "value": round(gsps, 2),
+            "unit": "steps/s",
+            "vs_baseline": round(gsps / 19.0, 2),
+            "secondary": {"prefetch_ab": ab},
+        }), flush=True)
+        return
     h2d_rates = bench_h2d(repeats=args.repeats)
     log(f"h2d link: {spread(h2d_rates)} MB/s (pure device_put, 64MB "
         f"buffer) — read ingest items/s against this")

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Chunked test-suite runner: one pytest process per test file.
+#
+# Why: the documented one-command `pytest tests/` invocation
+# reproducibly SIGSEGVs at ~85% inside XLA's backend_compile_and_load
+# on this image (VERDICT.md round 5) — an accumulation crash in the
+# long-lived XLA CPU client, not a test failure. Running each file in
+# its own interpreter bounds per-process compile-cache growth and makes
+# the full tier-2 suite (including -m slow, if you drop the filter)
+# completable in one command. The tier-1 command in ROADMAP.md stays
+# authoritative for CI gating; this script is the local full-suite
+# convenience.
+#
+# Usage:
+#   tests/run_chunked.sh                 # tier-1 scope, per-file
+#   tests/run_chunked.sh -m ''           # include slow tests
+#   tests/run_chunked.sh -k kbatch       # extra pytest args pass through
+set -u
+cd "$(dirname "$0")/.."
+
+fail=0
+failed_files=()
+for f in tests/test_*.py; do
+    echo "=== ${f}"
+    if ! env JAX_PLATFORMS=cpu python -m pytest "${f}" -q -m 'not slow' \
+        -p no:cacheprovider -p no:xdist -p no:randomly "$@"; then
+        fail=1
+        failed_files+=("${f}")
+    fi
+done
+
+echo
+if [ "${fail}" -ne 0 ]; then
+    echo "FAILED files: ${failed_files[*]}"
+else
+    echo "all files passed"
+fi
+exit "${fail}"

@@ -144,3 +144,54 @@ def test_driver_without_checkpoint_dir_has_no_manager():
         assert d.ckpt is None
     finally:
         d.server.stop()
+
+
+def test_checkpoint_layout_version_stamp_transparent(tmp_path):
+    """Every dict payload carries a storage-layout version stamp on
+    disk, yet callers never see it: restore() strips it after checking,
+    and item_keys() excludes it (the driver builds restore templates
+    from item_keys, so the stamp must stay invisible there)."""
+    import pytest
+
+    from ape_x_dqn_tpu.utils import checkpoint as ckpt_mod
+
+    mngr = CheckpointManager(str(tmp_path / "m"))
+    payload = {"params": {"w": np.ones((2, 3), np.float32)},
+               "step": np.asarray(5, np.int32)}
+    mngr.save(5, payload, wait=True)
+
+    # the stamp IS on disk...
+    raw = mngr._raw_item_keys(5)
+    assert raw is not None and ckpt_mod._LAYOUT_KEY in raw
+    # ...but item_keys() (the driver's template source) never shows it
+    assert mngr.item_keys(5) == {"params", "step"}
+    # ...and restore() strips it from the returned payload
+    got = mngr.restore(template=jax.tree.map(np.zeros_like, payload))
+    assert ckpt_mod._LAYOUT_KEY not in got
+    np.testing.assert_array_equal(got["params"]["w"], payload["params"]["w"])
+
+    # a version mismatch fails loudly WITH the recovery guidance
+    mngr.save(6, {**payload,
+                  ckpt_mod._LAYOUT_KEY: np.asarray(999, np.int32)},
+              wait=True)
+    with pytest.raises(RuntimeError, match="storage layout v999"):
+        mngr.restore(step=6, template=jax.tree.map(np.zeros_like, payload))
+    mngr.close()
+
+
+def test_checkpoint_structure_mismatch_guidance(tmp_path):
+    """An Orbax structure mismatch (e.g. a replay-bearing checkpoint
+    written under the pre-versioning layout restored into new-layout
+    shapes) surfaces as a RuntimeError carrying the documented recovery
+    guidance, not a raw Orbax traceback."""
+    import pytest
+
+    mngr = CheckpointManager(str(tmp_path / "m"))
+    mngr.save(3, {"params": {"w": np.ones((4, 4), np.float32)},
+                  "step": np.asarray(3, np.int32)}, wait=True)
+    bad_template = {"params": {"w": np.zeros((4, 4), np.float32)},
+                    "replay_frames": np.zeros((8, 128), np.uint8),
+                    "step": np.asarray(0, np.int32)}
+    with pytest.raises(RuntimeError, match="restart the run fresh"):
+        mngr.restore(step=3, template=bad_template)
+    mngr.close()

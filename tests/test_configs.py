@@ -114,6 +114,12 @@ def test_sample_chunk_gated_for_unimplemented_families():
         DPGLearner(actor.apply, critic.apply,
                    PrioritizedReplay(capacity=64), lcfg)
 
+    # same gate for the double-buffered sampling pipeline
+    lcfg = LearnerConfig(batch_size=8, sample_prefetch=True)
+    with pytest.raises(ValueError, match="sample_prefetch"):
+        DPGLearner(actor.apply, critic.apply,
+                   PrioritizedReplay(capacity=64), lcfg)
+
 
 def test_final_eval_deadline_is_configurable():
     """The end-of-run eval backstop budget must come from RunConfig —

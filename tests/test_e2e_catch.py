@@ -86,3 +86,20 @@ def test_cnn_learns_catch_kbatch():
     out = train_single_process(cfg, train_every=4, solve_return=4.0)
     assert out["episodes"] > 10
     assert out["last20_return"] >= 4.0, out
+
+
+@pytest.mark.slow
+def test_cnn_learns_catch_prefetch():
+    """Learning parity for the double-buffered sampler
+    (sample_chunk=4 + sample_prefetch=True): each macro-step's sample is
+    drawn against priorities predating the previous macro-step's
+    write-back (one-dispatch staleness, matching the reference's async
+    sampler), and the agent must still clear the same catch-rate bar as
+    the exact and fused K-batch paths with identical frame budget."""
+    import dataclasses
+    cfg = _catch_cfg(total_frames=20_000)
+    cfg = cfg.replace(learner=dataclasses.replace(
+        cfg.learner, sample_chunk=4, sample_prefetch=True))
+    out = train_single_process(cfg, train_every=4, solve_return=4.0)
+    assert out["episodes"] > 10
+    assert out["last20_return"] >= 4.0, out

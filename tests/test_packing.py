@@ -192,3 +192,49 @@ def test_check_hbm_fits_silent_without_memory_stats():
     # no hbm_bytes and a backend without memory stats -> returns budget
     b = hbm.check_hbm_fits(cfg, (84, 84, 4), np.uint8, hbm_bytes=None)
     assert b.total > 0
+
+
+def test_frame_mode_predicate_shared():
+    """sequence_frame_mode and frame_ring_mode are the SAME function
+    object (packing.frame_mode) — the two modules alias one predicate,
+    so single-frame-storage eligibility can never drift between the
+    sequence and flat frame-ring paths."""
+    from ape_x_dqn_tpu.replay.frame_ring import frame_ring_mode
+    from ape_x_dqn_tpu.replay.packing import frame_mode
+    from ape_x_dqn_tpu.replay.sequence import sequence_frame_mode
+
+    assert sequence_frame_mode is frame_mode
+    assert frame_ring_mode is frame_mode
+    assert frame_mode("frame_ring", (84, 84, 4))
+    assert not frame_mode("flat", (84, 84, 4))
+    assert not frame_mode("frame_ring", (4,))
+
+
+def test_replay_non_dividing_block_retires_tail_slots():
+    """The default ActorConfig.ingest_batch=50 does not divide a
+    power-of-two capacity, so skip-to-head wrap DOES fire on the flat
+    ingest path (the docstring's 'never occurs' only covers the
+    frame-ring/segment paths): up to block-1 tail slots are permanently
+    retired — priority 0, never sampled, never counted in size — a
+    bounded capacity loss, not a correctness hazard."""
+    cap, block = 64, 50
+    replay = PrioritizedReplay(capacity=cap)
+    state = replay.init({"x": jax.ShapeDtypeStruct((), jnp.float32)})
+    state = replay.add(state, _items(block, 0), jnp.ones(block))
+    assert int(state.pos) == 50 and int(state.size) == 50
+    # second block wraps: skip-to-head restarts at 0
+    state = replay.add(state, _items(block, 100), jnp.ones(block))
+    assert int(state.pos) == 50
+    # tail slots 50..63 were retired, never filled: size stays 50
+    assert int(state.size) == 50
+    from ape_x_dqn_tpu.ops import sum_tree
+    leaves = np.asarray(sum_tree.leaves(state.tree))
+    np.testing.assert_array_equal(leaves[50:64], 0.0)
+    # and retired slots are never sampled even over many draws
+    _, idx, _ = replay.sample(state, jax.random.key(0), 512)
+    assert np.asarray(idx).max() < 50
+    # steady state: every further block lands at 0..49
+    state = replay.add(state, _items(block, 200), jnp.ones(block))
+    assert int(state.pos) == 50 and int(state.size) == 50
+    stored = np.asarray(state.storage["x"])
+    np.testing.assert_array_equal(stored[:50], np.arange(200, 250))

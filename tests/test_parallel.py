@@ -329,3 +329,62 @@ def test_dist_kbatch_train_step_k():
 
     a, b = run_once(), run_once()
     jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def test_dist_prefetch_train_many():
+    """Double-buffered sampling on the (dp, tp) mesh: with
+    sample_prefetch=True train_many pipelines each macro-step's
+    per-shard stratified sample against the priorities predating the
+    previous macro-step's write-back. Mechanics (step counts, per-shard
+    tree repair, remainder path), first-macro equivalence to the fused
+    dist K-batch path, and run-twice determinism — the dist mirror of
+    test_runtime.test_prefetch_train_many_mechanics."""
+    import dataclasses
+
+    mesh = make_mesh(dp=4, tp=2)
+    spec = transition_item_spec((4,), jnp.float32)
+    lcfg = LearnerConfig(batch_size=32, target_sync_every=3,
+                         sample_chunk=4, sample_prefetch=True)
+
+    def build(prefetch=True):
+        net = build_network(
+            NetworkConfig(kind="mlp", mlp_hidden=(256,), dueling=False,
+                          compute_dtype="float32"), VEC_SPEC)
+        params = net.init(jax.random.key(0), jnp.zeros((1, 4)))
+        lrn = DistDQNLearner(
+            net.apply, PrioritizedReplay(capacity=64),
+            dataclasses.replace(lcfg, sample_prefetch=prefetch), mesh)
+        st = lrn.init(params, spec, jax.random.key(1))
+        return lrn, _ingest(lrn, st, 4, 48)
+
+    learner, state = build()
+    root_before = np.asarray(state.replay.tree)[:, 1].copy()
+
+    # 10 = 2 exact remainder steps + 2 pipelined macro-steps of 4
+    state, m = learner.train_many(state, 10)
+    assert int(state.step) == 10
+    assert np.isfinite(float(m["loss"]))
+    # every shard's tree total changed (per-shard write-back ran)
+    assert (np.asarray(state.replay.tree)[:, 1] != root_before).all()
+
+    # first-macro equivalence: one pipelined macro-step == one fused
+    # train_step_k on the same initial state (params AND shard trees)
+    l1, s1 = build(True)
+    l2, s2 = build(False)
+    s1, _ = l1.train_many(s1, 4)
+    s2, _ = l2.train_step_k(s2, 4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s1.params, s2.params)
+    np.testing.assert_array_equal(np.asarray(s1.replay.tree),
+                                  np.asarray(s2.replay.tree))
+
+    # determinism through the dist prefetch pipeline
+    def run_once():
+        lrn, st = build()
+        st, _ = lrn.train_many(st, 12)
+        return jax.tree.map(np.asarray, st.params)
+
+    a, b = run_once(), run_once()
+    jax.tree.map(np.testing.assert_array_equal, a, b)

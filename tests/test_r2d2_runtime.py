@@ -284,7 +284,8 @@ def test_r2d2_improves_masked_cartpole():
     assert out["eval"]["mean_return"] > 35, out["eval"]
 
 
-def _seq_learner_with_items(sample_chunk=1, n_items=64, seed=0):
+def _seq_learner_with_items(sample_chunk=1, n_items=64, seed=0,
+                            sample_prefetch=False):
     """Small SequenceLearner + filled replay for mechanics tests."""
     net = ApeXLSTMQNet(num_actions=2, lstm_size=8, dense=16,
                        compute_dtype="float32", mlp_torso=True)
@@ -295,7 +296,8 @@ def _seq_learner_with_items(sample_chunk=1, n_items=64, seed=0):
     spec = sequence_item_spec((2,), np.float32, 4, 8)
     lcfg = LearnerConfig(batch_size=8, n_step=2, value_rescale=True,
                          target_sync_every=3, lr=1e-3,
-                         sample_chunk=sample_chunk)
+                         sample_chunk=sample_chunk,
+                         sample_prefetch=sample_prefetch)
     rcfg = ReplayConfig(kind="sequence", seq_length=4, burn_in=1)
     learner = SequenceLearner(lambda p, o, s: net.apply(p, o, s),
                               replay, lcfg, rcfg)
@@ -349,6 +351,75 @@ def test_sequence_kbatch_determinism():
         return jax.tree.map(np.asarray, state.params)
     a, b = run(), run()
     jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+def test_sequence_prefetch_train_many_mechanics():
+    """sample_prefetch on the SequenceLearner: the double-buffered
+    train_many pipeline (next chunk's sequence sample drawn before this
+    chunk's priority write-back) holds the same step-count, remainder,
+    and sync-boundary contract as the fused K-batch path, and its first
+    macro-step is bit-identical to train_step_k (the prologue draw sees
+    the same priorities the fused path would)."""
+    learner, state = _seq_learner_with_items(sample_chunk=4,
+                                             sample_prefetch=True)
+    tree_before = np.asarray(state.replay.tree).copy()
+
+    state, m = learner.train_many(state, 8)   # pure macro-steps
+    assert int(state.step) == 8
+    assert np.isfinite(m["loss"]) and m["valid_frac"] > 0
+    assert np.asarray(state.replay.tree)[1] != tree_before[1]
+
+    state, m = learner.train_many(state, 10)  # 2 exact + 2 macro-steps
+    assert int(state.step) == 18
+    assert np.isfinite(m["loss"])
+
+    # step 18 is a sync boundary (sync_every=3): targets == online
+    t = jax.tree.leaves(jax.tree.map(np.asarray, state.target_params))
+    p = jax.tree.leaves(jax.tree.map(np.asarray, state.params))
+    for a, b in zip(t, p):
+        np.testing.assert_array_equal(a, b)
+
+    # first-macro equivalence against the fused path
+    l1, s1 = _seq_learner_with_items(sample_chunk=4, seed=2,
+                                     sample_prefetch=True)
+    l2, s2 = _seq_learner_with_items(sample_chunk=4, seed=2)
+    s1, _ = l1.train_many(s1, 4)
+    s2, _ = l2.train_step_k(s2, 4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s1.params, s2.params)
+    np.testing.assert_array_equal(np.asarray(s1.replay.tree),
+                                  np.asarray(s2.replay.tree))
+
+
+def test_sequence_prefetch_determinism():
+    """Same seed, same params through the sequence prefetch pipeline."""
+    def run():
+        learner, state = _seq_learner_with_items(sample_chunk=4, seed=3,
+                                                 sample_prefetch=True)
+        state, _ = learner.train_many(state, 12)
+        return jax.tree.map(np.asarray, state.params)
+    a, b = run(), run()
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+
+@pytest.mark.slow
+def test_r2d2_improves_masked_cartpole_prefetch():
+    """Learning parity for the double-buffered sampler on the recurrent
+    family: with sample_chunk=4 + sample_prefetch=True the masked
+    CartPole agent must clear the same eval bar as the exact path
+    (test_r2d2_improves_masked_cartpole) — the one-dispatch priority
+    staleness must not cost learning on the POMDP task."""
+    cfg = _r2d2_cfg(num_actors=2, lstm=64).replace(
+        eval_every_steps=0, eval_episodes=10, total_env_frames=40_000)
+    cfg = cfg.replace(learner=dataclasses.replace(
+        cfg.learner, sample_chunk=4, sample_prefetch=True))
+    driver = ApexDriver(cfg)
+    out = driver.run(max_grad_steps=10**9, wall_clock_limit_s=480)
+    assert out["actor_errors"] == [] and out["loop_errors"] == []
+    assert out["eval"] is not None
+    assert out["eval"]["mean_return"] > 35, out["eval"]
 
 
 def test_dist_sequence_kbatch_train_step_k():

@@ -247,3 +247,113 @@ def test_replay_sample_partially_filled_never_returns_unfilled():
         _, idx, w = rp.sample(st, jax.random.key(seed), 32)
         assert int(idx.max()) < 5
         assert (np.asarray(w) > 0).all()
+
+
+# ---------------------------------------------------------------------------
+# split sample/update entry points + deterministic packer construction
+
+
+def test_replay_split_entry_points_delegate():
+    """sample_state / update_state are the prefetch pipeline's split
+    entry points: sample_state(state, ...) must equal sample(state, ...)
+    bit-for-bit, and update_state must write ONLY the tree — storage,
+    pos, and size unchanged — which is the commuting contract that lets
+    a prefetched draw run before the previous chunk's write-back."""
+    rp = PrioritizedReplay(capacity=16, alpha=1.0, beta=0.5)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 8), jnp.ones(8))
+
+    a = rp.sample(state, jax.random.key(3), 16)
+    b = rp.sample_state(state, jax.random.key(3), 16)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        a, b)
+
+    _, idx, _ = a
+    new = rp.update_state(state, idx, jnp.full(idx.shape, 0.25))
+    ref = rp.update_priorities(state, idx, jnp.full(idx.shape, 0.25))
+    np.testing.assert_array_equal(np.asarray(new.tree), np.asarray(ref.tree))
+    # tree changed; everything else is untouched
+    assert (np.asarray(new.tree) != np.asarray(state.tree)).any()
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        new.storage, state.storage)
+    assert int(new.pos) == int(state.pos)
+    assert int(new.size) == int(state.size)
+
+
+def test_uniform_replay_split_entry_points():
+    rp = UniformReplayDevice(capacity=16)
+    state = rp.init(_spec())
+    state = rp.add(state, _items(0, 8), jnp.ones(8))
+    a = rp.sample(state, jax.random.key(1), 8)
+    b = rp.sample_state(state, jax.random.key(1), 8)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        a, b)
+    # uniform replay's priority write-back is a no-op either way
+    new = rp.update_state(state, a[1], jnp.ones(a[1].shape))
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        new, state)
+
+
+def test_frame_ring_split_entry_points():
+    """FrameRingReplay inherits sample_state/update_state through its
+    overridden sample_items/update_priorities (dead-slot guard
+    included), so the prefetch pipeline works unchanged on pixel
+    frame-ring storage."""
+    from ape_x_dqn_tpu.replay.frame_ring import (FrameRingReplay,
+                                                 FrameSegmentBuilder)
+
+    rp = FrameRingReplay(capacity=16, seg_transitions=4, n_step=1,
+                         obs_shape=(6, 6, 2))
+    state = rp.init()
+    builder = FrameSegmentBuilder(4, 1, 2)
+    builder.on_reset(np.zeros((6, 6, 2), np.uint8))  # stacked obs
+    for t in range(8):
+        builder.on_step(np.full((6, 6, 2), t + 1, np.uint8))
+        builder.add(0, 0.0, 0.99, 1, priority=1.0 + t)
+    for seg in builder.flush():
+        items = {k: jnp.asarray(seg[k]) for k in
+                 ("seg_frames", "action", "reward", "discount",
+                  "next_off")}
+        state = rp.add(state, items, jnp.asarray(seg["priorities"]))
+    a = rp.sample(state, jax.random.key(2), 8)
+    b = rp.sample_state(state, jax.random.key(2), 8)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        a, b)
+    new = rp.update_state(state, a[1], jnp.full(a[1].shape, 0.5))
+    ref = rp.update_priorities(state, a[1], jnp.full(a[1].shape, 0.5))
+    np.testing.assert_array_equal(np.asarray(new.tree),
+                                  np.asarray(ref.tree))
+
+
+def test_replay_constructor_item_spec():
+    """Deterministic packer construction: a replay built with item_spec
+    in the constructor needs no spec at init() time, and an init() with
+    no spec anywhere raises a loud ValueError instead of failing later
+    inside the packer (the old hidden init() side effect)."""
+    rp = PrioritizedReplay(capacity=16, item_spec=_spec())
+    state = rp.init()  # no spec argument needed
+    state = rp.add(state, _items(0, 4), jnp.ones(4))
+    items, idx, _ = rp.sample(state, jax.random.key(0), 8)
+    np.testing.assert_allclose(np.asarray(items["act"]), np.asarray(idx))
+
+    with pytest.raises(ValueError, match="item spec"):
+        PrioritizedReplay(capacity=16).init()
+    with pytest.raises(ValueError, match="item spec"):
+        UniformReplayDevice(capacity=16).init()
+
+    # and the constructor spec matches the init(spec) layout exactly
+    s2 = PrioritizedReplay(capacity=16).init(_spec())
+    jax.tree.map(
+        lambda x, y: (x.shape, x.dtype) == (y.shape, y.dtype) or
+        pytest.fail("layout mismatch"),
+        state.storage, s2.storage)

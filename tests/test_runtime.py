@@ -438,6 +438,130 @@ def test_kbatch_chunks_span_full_priority_range():
     assert contig[0].max() < cap * 0.5
 
 
+def _prefetch_learner(sample_prefetch, seed=5, sample_chunk=4):
+    """Small DQNLearner + filled replay for the prefetch pipeline tests.
+    Identical construction across calls so the prefetch=True/False arms
+    start from bit-identical state."""
+    from ape_x_dqn_tpu.envs.cartpole import CartPole
+    from ape_x_dqn_tpu.models import build_network
+    from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
+    from ape_x_dqn_tpu.runtime.learner import (DQNLearner,
+                                               transition_item_spec)
+    from ape_x_dqn_tpu.utils.rng import component_key
+
+    spec = CartPole().spec
+    rng = np.random.default_rng(seed)
+    n = 256
+    items = {
+        "obs": rng.standard_normal((n, 4)).astype(np.float32),
+        "action": rng.integers(0, 2, n).astype(np.int32),
+        "reward": rng.standard_normal(n).astype(np.float32),
+        "next_obs": rng.standard_normal((n, 4)).astype(np.float32),
+        "discount": np.full(n, 0.97, np.float32),
+    }
+    net = build_network(NetworkConfig(kind="mlp", mlp_hidden=(32,)), spec)
+    params = net.init(component_key(seed, "net"),
+                      np.zeros((1, 4), np.float32))
+    lcfg = LearnerConfig(batch_size=32, sample_chunk=sample_chunk,
+                         sample_prefetch=sample_prefetch,
+                         target_sync_every=3)
+    learner = DQNLearner(net.apply, PrioritizedReplay(capacity=512), lcfg)
+    state = learner.init(
+        params,
+        learner.replay.init(transition_item_spec(spec.obs_shape,
+                                                 spec.obs_dtype)),
+        component_key(seed, "learner"))
+    state = learner.add(state, items,
+                        rng.random(n).astype(np.float32) + 0.1)
+    return learner, state
+
+
+def test_prefetch_train_many_mechanics():
+    """sample_prefetch=True routes train_many through the double-buffered
+    pipeline: the scan body draws macro-step n+1's sample against the
+    priorities BEFORE macro-step n's write-back. Step counts, metrics,
+    tree repair, the remainder (n % K) path, the target-sync boundary,
+    and run-twice determinism must all hold — mirroring
+    test_kbatch_train_many_mechanics for the fused path."""
+    import jax
+
+    learner, state = _prefetch_learner(True)
+    tree_before = np.asarray(state.replay.tree)
+
+    state, m = learner.train_many(state, 8)   # pure macro-steps
+    assert int(state.step) == 8
+    assert np.isfinite(m["loss"]) and np.isfinite(m["grad_norm"])
+    assert np.asarray(state.replay.tree)[1] != tree_before[1]
+
+    state, m = learner.train_many(state, 10)  # 2 exact + 2 macro-steps
+    assert int(state.step) == 18
+    assert np.isfinite(m["loss"])
+
+    # step 18 is a sync boundary (sync_every=3): targets == online
+    t, p = (jax.tree.leaves(jax.tree.map(np.asarray, state.target_params)),
+            jax.tree.leaves(jax.tree.map(np.asarray, state.params)))
+    for a, b in zip(t, p):
+        np.testing.assert_array_equal(a, b)
+
+    def run_once():
+        lrn, st = _prefetch_learner(True, seed=6)
+        st, _ = lrn.train_many(st, 12)
+        return jax.tree.map(np.asarray, st.params)
+
+    a, b = run_once(), run_once()
+    jax.tree.map(np.testing.assert_array_equal, a, b)
+
+    # k=1 + prefetch degenerates cleanly (every macro-step is one SGD
+    # step; the pipeline still draws one sample ahead)
+    lrn1, st1 = _prefetch_learner(True, seed=7, sample_chunk=1)
+    st1, m1 = lrn1.train_many(st1, 5)
+    assert int(st1.step) == 5 and np.isfinite(m1["loss"])
+
+
+def test_prefetch_first_macro_step_matches_fused():
+    """The pipeline prologue draws its first sample from the SAME
+    priorities the fused path would (no staleness yet), so one
+    macro-step through the prefetch train_many is bit-identical to one
+    train_step_k on the same initial state — params AND written-back
+    tree. This pins the prefetch path to the fused semantics everywhere
+    except the documented one-dispatch priority staleness."""
+    import jax
+
+    l1, s1 = _prefetch_learner(True)
+    l2, s2 = _prefetch_learner(False)
+    s1, _ = l1.train_many(s1, 4)
+    s2, _ = l2.train_step_k(s2, 4)
+    assert int(s1.step) == int(s2.step) == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s1.params, s2.params)
+    np.testing.assert_array_equal(np.asarray(s1.replay.tree),
+                                  np.asarray(s2.replay.tree))
+
+
+def test_prefetch_sample_learn_split_matches_fused():
+    """sample_k + learn_k composed on the host (the single_process.py
+    double-buffer prologue) reproduce train_step_k bit-exactly: the
+    split stages are the fused cycle cut at the sample/learn seam, with
+    the same RNG discipline."""
+    import jax
+
+    l1, s1 = _prefetch_learner(False)
+    l2, s2 = _prefetch_learner(False)
+    sample, rng2 = l1.sample_k(s1, 4)
+    s1, m1 = l1.learn_k(s1._replace(rng=rng2), sample, 4)
+    s2, m2 = l2.train_step_k(s2, 4)
+    assert int(s1.step) == int(s2.step) == 4
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        s1.params, s2.params)
+    np.testing.assert_array_equal(np.asarray(s1.replay.tree),
+                                  np.asarray(s2.replay.tree))
+    assert np.isfinite(m1["loss"]) and np.isfinite(m2["loss"])
+
+
 def test_eval_rotation_survives_transient_timeout(tmp_path, monkeypatch):
     """A transient inference-server TimeoutError during one rotation
     eval must not kill the eval thread for the rest of the run (the
