@@ -23,8 +23,11 @@ Layout:
     replay/   — uniform / prioritized / sequence replay buffers
     parallel/ — mesh, shardings, collectives, batched inference server
     comm/     — transport abstraction (loopback queues, sockets for DCN)
+    obs/      — span tracing, metric registry, stall watchdog, reporting
     runtime/  — actor / learner / replay-server / driver orchestration
     cpp/      — native C++ host components (sum-tree, ingest ring buffer)
 """
 
-__version__ = "0.1.0"
+# keep in sync with pyproject.toml [project].version — log_run_header
+# stamps this into every run's JSONL
+__version__ = "0.2.0"

@@ -175,6 +175,43 @@ class ParallelConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Observability layer (ape_x_dqn_tpu/obs): span tracing, metric
+    registry, heartbeat stall watchdog. Disabled by default — the
+    runtime then routes every obs call through the no-op NullObs, so
+    the hot loops carry ~zero instrumentation overhead (the learner
+    jits are never touched either way)."""
+
+    enabled: bool = False
+    # Chrome/Perfetto trace_event JSON output path ("" = no trace file;
+    # spans still aggregate into the JSONL stage-time breakdown).
+    # Load in chrome://tracing or https://ui.perfetto.dev.
+    trace_path: str = ""
+    # bounded span buffer: beyond this, events still count toward the
+    # stage aggregates but drop from the trace file (memory cap)
+    trace_max_events: int = 200_000
+    # publish cadence for the registry -> JSONL snapshot (grad-steps);
+    # drivers also publish once at shutdown
+    publish_every_steps: int = 500
+    # heartbeat watchdog: a component (actor-i / ingest / learner /
+    # inference-server) silent this long makes the driver raise an
+    # attributed StallError instead of hanging. Must exceed the longest
+    # legitimate gap (a cold inference-server bucket compile can hold
+    # actors for 10-40s on TPU; the 60s query timeout bounds it).
+    # 0 disables the watchdog.
+    heartbeat_timeout_s: float = 120.0
+    # opt-in jax.profiler window (XLA-level twin of the span trace):
+    # trace this many grad-steps into jax_profile_dir starting at the
+    # first training dispatch ("" = off)
+    jax_profile_dir: str = ""
+    jax_profile_steps: int = 24
+    # log each warmed jit's XLA memory_analysis() into the JSONL
+    # (hbm/<jit>/<field> keys — the measured anchors utils/hbm.py's
+    # static budget calibrates against)
+    hbm_dump: bool = True
+
+
+@dataclass(frozen=True)
 class RunConfig:
     name: str = "cartpole_smoke"
     seed: int = 0
@@ -186,6 +223,9 @@ class RunConfig:
     actors: ActorConfig = field(default_factory=ActorConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    # observability (ape_x_dqn_tpu/obs): off by default; enable with
+    # --set obs.enabled=true [--set obs.trace_path=trace.json ...]
+    obs: ObsConfig = field(default_factory=ObsConfig)
     eval_every_steps: int = 10_000
     eval_episodes: int = 10
     eval_eps: float = 0.001

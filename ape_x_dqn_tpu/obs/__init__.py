@@ -1,0 +1,30 @@
+"""End-to-end observability for the actor→replay→learner loop.
+
+- obs.trace: Chrome/Perfetto `trace_event` span tracer (host-side).
+- obs.registry: counters / gauges / fixed-bucket histograms feeding
+  the canonical metrics JSONL.
+- obs.health: heartbeats + attributed stall watchdogs.
+- obs.core: the `Obs` facade drivers thread through the runtime
+  (`build_obs(cfg.obs, metrics)`), with a no-op twin when disabled.
+- obs.report: offline CLI (`python -m ape_x_dqn_tpu.obs.report`).
+
+Everything here is jax-free at import time (the multihost StallWatchdog
+defers its jax import) so the report CLI stays cheap to start.
+"""
+
+from ape_x_dqn_tpu.obs.core import (
+    NULL_OBS, NullObs, Obs, SampleAgeTracker, build_obs)
+from ape_x_dqn_tpu.obs.health import (
+    HeartbeatRegistry, HeartbeatWatchdog, StallError, StallWatchdog)
+from ape_x_dqn_tpu.obs.registry import (
+    Counter, Gauge, Histogram, MetricRegistry, geometric_edges)
+from ape_x_dqn_tpu.obs.trace import (
+    NULL_TRACER, NullTracer, SpanTracer, load_trace, span_names)
+
+__all__ = [
+    "NULL_OBS", "NULL_TRACER", "Counter", "Gauge", "HeartbeatRegistry",
+    "HeartbeatWatchdog", "Histogram", "MetricRegistry", "NullObs",
+    "NullTracer", "Obs", "SampleAgeTracker", "SpanTracer", "StallError",
+    "StallWatchdog", "build_obs", "geometric_edges", "load_trace",
+    "span_names",
+]

@@ -1,0 +1,310 @@
+"""Obs facade: one object the runtime threads spans/metrics/beats into.
+
+Drivers build one `Obs` from `configs.ObsConfig` and hand it to their
+components (actors, ingest, learner loop, inference server). Every
+call site goes through this facade so the disabled path is a method
+call on the `NullObs` singleton — no conditionals in runtime code, and
+~zero overhead when observability is off (the acceptance bar: bench
+grad-steps/s unchanged with ObsConfig disabled, which trivially holds
+because the learner jits are untouched and disabled drivers never call
+into numpy or locks here).
+
+First-class Ape-X health instruments (ISSUE 2 / Horgan et al. 2018 §4):
+- hist `sample_age_steps`: learner grad-step minus the grad-step at
+  which each sampled transition was written (via `SampleAgeTracker`,
+  a host-side mirror of the flat ring's skip-to-head write cursor).
+- hist `param_lag_steps`: learner grad-step minus the param version
+  the inference server served a batch with (actor parameter lag).
+- hist `td_abs`: per-dispatch mean |TD| (the priority signal).
+- hist `server_batch_items`: dynamic-batching fill.
+- gauges `replay_occupancy`, `server_queue_depth`, counters for adds,
+  dispatches, stall strikes.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ape_x_dqn_tpu.obs.health import (
+    HeartbeatRegistry, HeartbeatWatchdog, StallError)
+from ape_x_dqn_tpu.obs.registry import MetricRegistry, geometric_edges
+from ape_x_dqn_tpu.obs.trace import NULL_TRACER, SpanTracer
+
+AGE_EDGES = geometric_edges(1.0, 1e6, per_decade=4)
+LAG_EDGES = geometric_edges(1.0, 1e5, per_decade=4)
+TD_EDGES = geometric_edges(1e-3, 1e3, per_decade=4)
+BATCH_EDGES = tuple(float(2 ** i) for i in range(12))
+
+
+class NullObs:
+    """No-op twin: the runtime threads call this when obs is disabled.
+    Keep method-for-method parity with Obs."""
+
+    enabled = False
+    tracer = NULL_TRACER
+    watchdog = None
+
+    def span(self, name: str, **args: Any):
+        return NULL_TRACER.span(name)
+
+    def mark(self, name: str, **args: Any) -> None:
+        pass
+
+    def register(self, name: str) -> None:
+        pass
+
+    def beat(self, name: str, note: str = "") -> None:
+        pass
+
+    def clear(self, name: str) -> None:
+        pass
+
+    def check_stalled(self) -> None:
+        pass
+
+    def observe(self, hist: str, value) -> None:
+        pass
+
+    def observe_many(self, hist: str, values) -> None:
+        pass
+
+    def gauge(self, name: str, value) -> None:
+        pass
+
+    def count(self, name: str, n: float = 1.0) -> None:
+        pass
+
+    def set_learner_step(self, step: int) -> None:
+        pass
+
+    def on_server_batch(self, items: int, params_version: int,
+                        queue_depth: int) -> None:
+        pass
+
+    def age_tracker(self, capacity: int) -> "SampleAgeTracker | None":
+        return None
+
+    def observe_sample_ages(self, ages) -> None:
+        pass
+
+    def log_compiled(self, tag: str, compiled) -> None:
+        pass
+
+    def maybe_profile(self, step: int) -> None:
+        pass
+
+    def publish(self, step: int) -> None:
+        pass
+
+    def close(self, step: int = 0) -> None:
+        pass
+
+
+NULL_OBS = NullObs()
+
+
+class SampleAgeTracker:
+    """Host-side mirror of the flat replay ring's write cursor.
+
+    The device ReplayState records no write times; adding them to the
+    storage pytree would grow every add/sample graph for a metric. But
+    flat ring writes are sequential with skip-to-head wrap
+    (replay/packing.ring_write_start), so the host can mirror the
+    cursor exactly: `on_add` stamps the written slots with the current
+    grad-step, and `ages(idx, step)` maps sampled slot indices back to
+    write steps. Valid for the flat layouts (PrioritizedReplay /
+    UniformReplayDevice) whose adds all flow through one host loop —
+    the single-process driver's case."""
+
+    def __init__(self, capacity: int):
+        self._write_step = np.zeros(capacity, np.int64)
+        self._pos = 0
+        self._cap = capacity
+
+    def on_add(self, n: int, grad_step: int) -> None:
+        if n <= 0:
+            return
+        n = min(n, self._cap)
+        # skip-to-head: a block that would cross the ring boundary
+        # restarts at slot 0 (must match replay/packing.ring_write_start)
+        start = self._pos if self._pos + n <= self._cap else 0
+        self._write_step[start:start + n] = grad_step
+        self._pos = (start + n) % self._cap
+
+    def ages(self, idx, grad_step: int) -> np.ndarray:
+        slots = np.asarray(idx).ravel()
+        return grad_step - self._write_step[slots]
+
+
+class Obs:
+    """Live observability session for one driver run."""
+
+    enabled = True
+
+    def __init__(self, cfg, metrics):
+        """cfg: configs.ObsConfig (enabled already checked by build_obs);
+        metrics: the run's utils.metrics.Metrics sink."""
+        self.cfg = cfg
+        self.metrics = metrics
+        self.tracer = (SpanTracer(cfg.trace_path, cfg.trace_max_events)
+                       if cfg.trace_path else NULL_TRACER)
+        self.registry = MetricRegistry()
+        self.heartbeats = HeartbeatRegistry()
+        self.watchdog = (HeartbeatWatchdog(self.heartbeats,
+                                           cfg.heartbeat_timeout_s)
+                         if cfg.heartbeat_timeout_s > 0 else None)
+        # seed the first-class instruments so a short run publishes
+        # empty histograms rather than omitting the keys entirely
+        self.registry.histogram("sample_age_steps", AGE_EDGES)
+        self.registry.histogram("param_lag_steps", LAG_EDGES)
+        self.registry.histogram("td_abs", TD_EDGES)
+        self.registry.histogram("server_batch_items", BATCH_EDGES)
+        self._learner_step = 0
+        # jax.profiler window: False = armed, True = tracing,
+        # None = done/disabled (single capture per run)
+        self._prof_state: bool | None = (
+            False if getattr(cfg, "jax_profile_dir", "") else None)
+        self._prof_from = 0
+        self._closed = False
+
+    # -- tracing -----------------------------------------------------------
+
+    def span(self, name: str, **args: Any):
+        return self.tracer.span(name, **args)
+
+    def mark(self, name: str, **args: Any) -> None:
+        self.tracer.mark(name, **args)
+
+    # -- heartbeats / watchdog ---------------------------------------------
+
+    def register(self, name: str) -> None:
+        self.heartbeats.register(name)
+
+    def beat(self, name: str, note: str = "") -> None:
+        self.heartbeats.beat(name, note)
+
+    def clear(self, name: str) -> None:
+        self.heartbeats.clear(name)
+
+    def check_stalled(self) -> None:
+        """Called from the driver's (alive) supervisory loop; raises
+        StallError attributing the stalest silent component."""
+        if self.watchdog is not None:
+            try:
+                self.watchdog.check()
+            except StallError as e:
+                # the stall rides the JSONL stream too, so offline
+                # report sees it even when the raise is swallowed
+                self.count("stall_errors")
+                self.metrics.log(self._learner_step,
+                                 stall_component=e.component,
+                                 stall_staleness_s=e.staleness_s,
+                                 stall_note=e.last_note)
+                # flush the trace + final snapshot NOW: the artifacts
+                # matter most on the crash path, and not every caller
+                # wraps its loop in try/finally
+                self.close(self._learner_step)
+                raise
+
+    # -- instruments -------------------------------------------------------
+
+    def observe(self, hist: str, value) -> None:
+        self.registry.histogram(hist).observe(float(value))
+
+    def observe_many(self, hist: str, values) -> None:
+        self.registry.histogram(hist).observe_many(values)
+
+    def gauge(self, name: str, value) -> None:
+        self.registry.gauge(name).set(float(value))
+
+    def count(self, name: str, n: float = 1.0) -> None:
+        self.registry.counter(name).inc(n)
+
+    # -- staleness hooks ---------------------------------------------------
+
+    def set_learner_step(self, step: int) -> None:
+        # plain int attr write: GIL-atomic, read by the server thread
+        self._learner_step = int(step)
+
+    def on_server_batch(self, items: int, params_version: int,
+                        queue_depth: int) -> None:
+        """Inference-server hook, once per served batch: parameter lag
+        is how many grad-steps the served params trail the learner."""
+        self.observe("server_batch_items", items)
+        self.observe("param_lag_steps",
+                     max(self._learner_step - int(params_version), 0))
+        self.gauge("server_queue_depth", queue_depth)
+        self.beat("inference-server", f"batch of {items}")
+
+    def age_tracker(self, capacity: int) -> SampleAgeTracker:
+        return SampleAgeTracker(capacity)
+
+    def observe_sample_ages(self, ages) -> None:
+        self.observe_many("sample_age_steps", ages)
+
+    # -- jax integration ---------------------------------------------------
+
+    def log_compiled(self, tag: str, compiled) -> None:
+        """Record a compiled jit's XLA memory_analysis into the JSONL
+        (reuses utils/hbm.py's budget vocabulary: these are the
+        measured anchors the static budget is calibrated against)."""
+        if not getattr(self.cfg, "hbm_dump", True):
+            return
+        from ape_x_dqn_tpu.utils.hbm import compiled_memory_summary
+
+        summary = compiled_memory_summary(compiled)
+        if summary:
+            self.metrics.log(self._learner_step,
+                             **{f"hbm/{tag}/{k}": v
+                                for k, v in summary.items()})
+
+    def maybe_profile(self, step: int) -> None:
+        """Opt-in jax.profiler window (ObsConfig.jax_profile_dir):
+        trace `jax_profile_steps` grad-steps starting at the first
+        call — the XLA-level twin of the host-side span trace."""
+        if self._prof_state is None:
+            return
+        import jax
+
+        if self._prof_state is False:
+            jax.profiler.start_trace(self.cfg.jax_profile_dir)
+            self._prof_from = step
+            self._prof_state = True
+        elif step - self._prof_from >= self.cfg.jax_profile_steps:
+            jax.profiler.stop_trace()
+            self._prof_state = None
+            self.metrics.log(step, profile_trace=self.cfg.jax_profile_dir)
+
+    # -- publication -------------------------------------------------------
+
+    def publish(self, step: int) -> None:
+        """Snapshot every instrument + the span aggregates into one
+        JSONL record (`span/<name>` dicts carry the stage-time
+        breakdown obs/report.py prints)."""
+        self.set_learner_step(step)
+        agg = self.tracer.aggregates()
+        extra = {f"span/{name}": stats for name, stats in agg.items()}
+        self.registry.publish(self.metrics, step, extra=extra)
+
+    def close(self, step: int = 0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._prof_state is True:  # run ended inside the window
+            import jax
+
+            jax.profiler.stop_trace()
+            self._prof_state = None
+        self.publish(step)
+        self.tracer.close()
+
+
+def build_obs(obs_cfg, metrics) -> Obs | NullObs:
+    """NULL_OBS unless the config exists and is enabled — drivers call
+    this with `getattr(cfg, "obs", None)` so configs predating ObsConfig
+    keep working."""
+    if obs_cfg is None or not getattr(obs_cfg, "enabled", False):
+        return NULL_OBS
+    return Obs(obs_cfg, metrics)

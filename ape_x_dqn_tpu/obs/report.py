@@ -1,0 +1,203 @@
+"""Offline run report: `python -m ape_x_dqn_tpu.obs.report run.jsonl`.
+
+Summarizes one run's metrics JSONL — the single self-contained
+artifact every driver writes — into the questions that matter for an
+Ape-X run (SURVEY.md §5, ISSUE 2):
+
+- stage-time breakdown: where host wall-clock went, from the
+  `span/<name>` aggregates Obs.publish folds into the stream;
+- staleness: sampled-transition-age and actor-parameter-lag
+  percentiles from the `hist/<name>` snapshots (the failure mode
+  Horgan et al. 2018 §4 and Kapturowski et al. 2019 both name);
+- throughput: frames/s, grad-steps/s, totals;
+- stall events: every attributed watchdog record.
+
+Stdlib-only on purpose: the report must run anywhere the JSONL can be
+copied, with no jax (or even numpy) available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+# healthy ranges printed next to the staleness percentiles (and
+# documented in PERF.md "Observability"): Ape-X tolerates replay
+# staleness by design, but tails beyond these suggest the learner is
+# overrunning ingest (age) or the publish path is wedged (lag)
+HEALTHY = {
+    "sample_age_steps": ("p99", 200_000,
+                         "p99 sampled age beyond ~capacity suggests the "
+                         "learner free-runs over stale replay"),
+    "param_lag_steps": ("p99", 1_000,
+                        "p99 actor param lag should stay within a few "
+                        "publish_every periods"),
+}
+
+
+def load_records(path: str) -> list[dict]:
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail line of a killed run
+    return records
+
+
+def summarize(records: list[dict]) -> dict[str, Any]:
+    """Fold a record stream into one summary dict. Scalar/snapshot keys
+    are last-write-wins (each Obs.publish record carries cumulative
+    state); stall events accumulate."""
+    latest: dict[str, Any] = {}
+    stalls: list[dict] = []
+    for rec in records:
+        for k, v in rec.items():
+            if v is not None:
+                latest[k] = v
+        if rec.get("stall_component") is not None:
+            stalls.append({"step": rec.get("step"),
+                           "component": rec["stall_component"],
+                           "staleness_s": rec.get("stall_staleness_s"),
+                           "note": rec.get("stall_note")})
+    spans = {k[len("span/"):]: v for k, v in latest.items()
+             if k.startswith("span/") and isinstance(v, dict)}
+    hists = {k[len("hist/"):]: v for k, v in latest.items()
+             if k.startswith("hist/") and isinstance(v, dict)}
+    hbm = {k[len("hbm/"):]: v for k, v in latest.items()
+           if k.startswith("hbm/")}
+    header_keys = ("run_name", "version", "sample_chunk",
+                   "sample_prefetch", "replay_kind", "replay_storage",
+                   "replay_capacity", "batch_size", "train_chunk",
+                   "dp", "tp")
+    return {
+        "header": {k: latest[k] for k in header_keys if k in latest},
+        "throughput": {
+            "final_step": latest.get("step", 0),
+            "frames": latest.get("frames"),
+            "frames_per_s": latest.get("frames_per_s"),
+            "grad_steps_per_s": latest.get("grad_steps_per_s"),
+            "loss": latest.get("loss"),
+            "avg_return": latest.get("avg_return"),
+        },
+        "spans": spans,
+        "hists": hists,
+        "hbm": hbm,
+        "stalls": stalls,
+    }
+
+
+def _fmt_spans(spans: dict[str, dict]) -> list[str]:
+    lines = ["stage-time breakdown (host spans):",
+             f"  {'stage':<28} {'count':>8} {'total_s':>9} "
+             f"{'mean_ms':>9} {'max_ms':>9} {'share':>7}"]
+    grand = sum(s.get("total_s", 0.0) for s in spans.values()) or 1.0
+    order = sorted(spans.items(),
+                   key=lambda kv: -kv[1].get("total_s", 0.0))
+    for name, s in order:
+        count = int(s.get("count", 0))
+        total = float(s.get("total_s", 0.0))
+        mean_ms = total / count * 1e3 if count else 0.0
+        tag = " (fused)" if total == 0.0 and count else ""
+        lines.append(
+            f"  {name:<28} {count:>8} {total:>9.3f} {mean_ms:>9.3f} "
+            f"{float(s.get('max_s', 0.0)) * 1e3:>9.3f} "
+            f"{total / grand:>6.1%}{tag}")
+    return lines
+
+
+def _fmt_hist(name: str, h: dict) -> list[str]:
+    count = int(h.get("count", 0))
+    if not count:
+        return [f"  {name:<22} (empty)"]
+    mean = h.get("sum", 0.0) / count
+    line = (f"  {name:<22} n={count:<9} mean={mean:<10.2f} "
+            f"p50={_n(h.get('p50')):<8} p90={_n(h.get('p90')):<8} "
+            f"p99={_n(h.get('p99')):<8} max={_n(h.get('max'))}")
+    out = [line]
+    if name in HEALTHY:
+        pct, bound, why = HEALTHY[name]
+        v = h.get(pct)
+        if v is not None and v > bound:
+            out.append(f"    ⚠ {pct}={_n(v)} exceeds healthy ~{bound}: "
+                       f"{why}")
+    return out
+
+
+def _n(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return f"{v:.3g}" if isinstance(v, float) else str(v)
+
+
+def format_report(summary: dict[str, Any]) -> str:
+    lines: list[str] = []
+    hdr = summary["header"]
+    if hdr:
+        lines.append("run: " + ", ".join(f"{k}={_n(v)}"
+                                         for k, v in hdr.items()))
+    tp = summary["throughput"]
+    lines.append(
+        f"throughput: step={_n(tp['final_step'])} "
+        f"frames={_n(tp['frames'])} "
+        f"frames/s={_n(tp['frames_per_s'])} "
+        f"grad-steps/s={_n(tp['grad_steps_per_s'])} "
+        f"loss={_n(tp['loss'])} avg_return={_n(tp['avg_return'])}")
+    if summary["spans"]:
+        lines.append("")
+        lines.extend(_fmt_spans(summary["spans"]))
+    if summary["hists"]:
+        lines.append("")
+        lines.append("staleness / distribution percentiles:")
+        for name in sorted(summary["hists"]):
+            lines.extend(_fmt_hist(name, summary["hists"][name]))
+    if summary["hbm"]:
+        lines.append("")
+        lines.append("compiled memory (XLA memory_analysis, bytes):")
+        for k in sorted(summary["hbm"]):
+            lines.append(f"  {k:<40} {_n(summary['hbm'][k])}")
+    lines.append("")
+    if summary["stalls"]:
+        lines.append(f"stall events: {len(summary['stalls'])}")
+        for s in summary["stalls"]:
+            lines.append(
+                f"  step={_n(s['step'])} component={s['component']} "
+                f"silent={_n(s['staleness_s'])}s note={s['note']!r}")
+    else:
+        lines.append("stall events: none")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ape_x_dqn_tpu.obs.report",
+        description="Summarize a run's metrics JSONL: stage times, "
+                    "staleness percentiles, throughput, stalls.")
+    ap.add_argument("jsonl", help="metrics JSONL file (--metrics-file "
+                                  "of a run with obs enabled)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object instead "
+                         "of the text report")
+    args = ap.parse_args(argv)
+    records = load_records(args.jsonl)
+    if not records:
+        print(f"no records in {args.jsonl}", file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(format_report(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,6 +35,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ape_x_dqn_tpu.obs.core import NULL_OBS
 from ape_x_dqn_tpu.utils.misc import next_pow2
 
 
@@ -58,11 +59,14 @@ class _Request:
 class BatchedInferenceServer:
     def __init__(self, apply_fn: Callable, params: Any,
                  max_batch: int = 64, deadline_ms: float = 2.0,
-                 mesh: Mesh | None = None):
+                 mesh: Mesh | None = None, obs: Any = None):
         """apply_fn(params, batched_inputs_pytree) -> batched outputs.
 
         mesh: optional — shard every batch's leading axis over all mesh
         devices (params replicated); see module docstring.
+        obs: optional obs.core.Obs facade — per-batch span + batch-fill
+        / param-lag / queue-depth instruments and the server heartbeat
+        (NULL_OBS when omitted, so the hot loop stays branch-free).
         """
         if mesh is not None:
             # One sharding as a pytree prefix: dim 0 of every input and
@@ -96,6 +100,8 @@ class BatchedInferenceServer:
         self._lock = threading.Lock()
         self._batches_served = 0
         self._items_served = 0
+        self._obs = obs if obs is not None else NULL_OBS
+        self._obs.register("inference-server")
         self._thread = threading.Thread(target=self._serve_loop,
                                         name="inference-server", daemon=True)
         self._thread.start()
@@ -244,6 +250,10 @@ class BatchedInferenceServer:
         while not self._stop.is_set():
             reqs = self._collect()
             if not reqs:
+                # an idle-but-polling server is alive, not stalled: beat
+                # so a wedged ACTOR gets the stall attribution instead of
+                # the server it simply stopped querying
+                self._obs.beat("inference-server", "idle")
                 continue
             try:
                 self._serve_batch(reqs)
@@ -263,18 +273,21 @@ class BatchedInferenceServer:
     def _serve_batch(self, reqs: list[_Request]) -> None:
         n = sum(r.items for r in reqs)
         padded = self._bucket(n)
-        # every request's leaves get a leading batch dim (single-item
-        # requests gain one), then requests concatenate into one batch
-        leads = [r.inputs if r.n else
-                 jax.tree.map(lambda x: np.asarray(x)[None], r.inputs)
-                 for r in reqs]
-        stacked = jax.tree.map(lambda *xs: _pad_concat(xs, padded), *leads)
-        if self._batched_sharding is not None:
-            stacked = jax.device_put(stacked, self._batched_sharding)
-        with self._lock:
-            params = self._params
-        out = self._apply(params, stacked)
-        out_np = jax.tree.map(np.asarray, out)
+        with self._obs.span("server.batch", items=n, padded=padded):
+            # every request's leaves get a leading batch dim (single-
+            # item requests gain one), then requests concatenate
+            leads = [r.inputs if r.n else
+                     jax.tree.map(lambda x: np.asarray(x)[None], r.inputs)
+                     for r in reqs]
+            stacked = jax.tree.map(lambda *xs: _pad_concat(xs, padded),
+                                   *leads)
+            if self._batched_sharding is not None:
+                stacked = jax.device_put(stacked, self._batched_sharding)
+            with self._lock:
+                params = self._params
+                version = self._params_version
+            out = self._apply(params, stacked)
+            out_np = jax.tree.map(np.asarray, out)
         off = 0
         for r in reqs:
             if r.n:
@@ -287,6 +300,7 @@ class BatchedInferenceServer:
             r.event.set()
         self._batches_served += 1
         self._items_served += n
+        self._obs.on_server_batch(n, version, self._q.qsize())
 
 
 def _pad_concat(xs: tuple, padded: int) -> np.ndarray:

@@ -24,6 +24,7 @@ import numpy as np
 
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
+from ape_x_dqn_tpu.obs.core import NULL_OBS
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
 from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
 from ape_x_dqn_tpu.replay.sequence import (
@@ -178,12 +179,17 @@ class Actor(DiscretePolicyHooks):
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[np.ndarray], np.ndarray],
                  transport, seed: int | None = None,
-                 episode_callback: Callable[[int, dict], None] | None = None):
-        """query_fn(obs) -> q-values [A] (the inference server's .query)."""
+                 episode_callback: Callable[[int, dict], None] | None = None,
+                 obs: object | None = None):
+        """query_fn(obs) -> q-values [A] (the inference server's .query).
+        obs: optional obs.core.Obs facade — inference/env-step spans +
+        the actor-{i} heartbeat (NULL_OBS when omitted)."""
         self.cfg = cfg
         self.index = actor_index
         self.query = query_fn
         self.transport = transport
+        self.obs = obs if obs is not None else NULL_OBS
+        self._hb = f"actor-{actor_index}"
         self.eps = actor_epsilon(actor_index, cfg.actors.num_actors,
                                  cfg.actors.base_eps, cfg.actors.eps_alpha)
         seed = cfg.seed if seed is None else seed
@@ -274,10 +280,13 @@ class Actor(DiscretePolicyHooks):
             self._seg.on_reset(obs)
         while self.frames < max_frames and not (
                 stop_event is not None and stop_event.is_set()):
-            out = self.query(obs)
+            self.obs.beat(self._hb)
+            with self.obs.span("actor.inference"):
+                out = self.query(obs)
             self._resolve_pending(out)
             action = self._select_action(out, self.eps)
-            next_obs, reward, done, info = self.env.step(action)
+            with self.obs.span("actor.env_step"):
+                next_obs, reward, done, info = self.env.step(action)
             self.frames += 1
             self._frames_unshipped += 1
             if self._seg is not None:
@@ -329,9 +338,10 @@ class ContinuousActor(ContinuousPolicyHooks, Actor):
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[np.ndarray], dict],
                  transport, seed: int | None = None,
-                 episode_callback: Callable[[int, dict], None] | None = None):
+                 episode_callback: Callable[[int, dict], None] | None = None,
+                 obs: object | None = None):
         super().__init__(cfg, actor_index, query_fn, transport, seed=seed,
-                         episode_callback=episode_callback)
+                         episode_callback=episode_callback, obs=obs)
         self._init_noise(cfg)
 
 
@@ -364,9 +374,10 @@ class RecurrentActor(Actor):
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[dict], dict],
                  transport, seed: int | None = None,
-                 episode_callback: Callable[[int, dict], None] | None = None):
+                 episode_callback: Callable[[int, dict], None] | None = None,
+                 obs: object | None = None):
         super().__init__(cfg, actor_index, query_fn, transport, seed=seed,
-                         episode_callback=episode_callback)
+                         episode_callback=episode_callback, obs=obs)
         self.gamma = cfg.learner.gamma
         self.lstm_size = cfg.network.lstm_size
         frame_mode = cfg.replay.storage == "frame_ring"
@@ -406,7 +417,9 @@ class RecurrentActor(Actor):
         prev: dict | None = None  # step awaiting its 1-step TD bootstrap
         while self.frames < max_frames and not (
                 stop_event is not None and stop_event.is_set()):
-            out = self.query({"obs": obs, "c": c, "h": h})
+            self.obs.beat(self._hb)
+            with self.obs.span("actor.inference"):
+                out = self.query({"obs": obs, "c": c, "h": h})
             q = out["q"]
             if prev is not None:
                 td = (prev["reward"] + self.gamma * float(np.max(q))

@@ -29,6 +29,7 @@ from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.obs.core import build_obs
 from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
@@ -77,6 +78,9 @@ class ApexDriver:
         actor hosts over DCN."""
         self.cfg = cfg
         self.metrics = metrics or Metrics()
+        # observability facade (obs/): NULL_OBS unless cfg.obs.enabled,
+        # so every span/beat below is a no-op method call when off
+        self.obs = build_obs(getattr(cfg, "obs", None), self.metrics)
         probe_env = make_env(cfg.env, seed=cfg.seed)
         self.spec = probe_env.spec
         self.net = build_network(cfg.network, self.spec)
@@ -167,7 +171,8 @@ class ApexDriver:
             max_batch=cfg.inference.max_batch,
             deadline_ms=cfg.inference.deadline_ms,
             mesh=self.mesh if (self.is_dist
-                               and cfg.inference.shard_over_mesh) else None)
+                               and cfg.inference.shard_over_mesh) else None,
+            obs=self.obs)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
         # initial publication so remote actor hosts can bootstrap before
@@ -250,8 +255,9 @@ class ApexDriver:
         return {k: jax.tree.map(np.asarray, v) for k, v in dev.items()}
 
     def _save_checkpoint(self, wait: bool = False) -> None:
-        self.ckpt.save(self._grad_steps_total, self._ckpt_payload(),
-                       wait=wait)
+        with self.obs.span("ckpt.save", step=self._grad_steps_total):
+            self.ckpt.save(self._grad_steps_total, self._ckpt_payload(),
+                           wait=wait)
 
     def _maybe_restore(self) -> None:
         if self.ckpt.latest_step() is None:
@@ -266,7 +272,8 @@ class ApexDriver:
         with_replay = (("replay" in saved) if saved is not None
                        else self.cfg.checkpoint_replay)
         template = self._ckpt_payload(with_replay=with_replay)
-        restored = self.ckpt.restore(template=template)
+        with self.obs.span("ckpt.restore"):
+            restored = self.ckpt.restore(template=template)
         if restored is None:
             return
         # land each leaf back on device with the layout the learner state
@@ -322,6 +329,18 @@ class ApexDriver:
         remaining = max_frames
         restarts_left = self.cfg.actors.max_restarts
         attempt = 0
+        # registered here (not in the actor) so a constructor/run that
+        # wedges before its first beat is still attributable
+        self.obs.register(f"actor-{i}")
+        try:
+            self._actor_attempts(i, actor_cls, query, remaining,
+                                 restarts_left, attempt)
+        finally:
+            # a finished actor is not a stalled one
+            self.obs.clear(f"actor-{i}")
+
+    def _actor_attempts(self, i, actor_cls, query, remaining,
+                        restarts_left, attempt) -> None:
         while remaining > 0 and not self.stop_event.is_set():
             actor = None
             try:
@@ -333,7 +352,8 @@ class ApexDriver:
                         else self.cfg.seed + 7907 * attempt)
                 actor = actor_cls(self.cfg, i, query,
                                   self.transport, seed=seed,
-                                  episode_callback=self._on_episode)
+                                  episode_callback=self._on_episode,
+                                  obs=self.obs)
                 actor.run(remaining, self.stop_event)
                 return  # frames counted at ingest
             except Exception as e:
@@ -365,15 +385,20 @@ class ApexDriver:
                 self.loop_errors.append(("ingest", e))
 
     def _ingest_loop_inner(self) -> None:
-        while not self.stop_event.is_set():
-            batch = self.transport.recv_experience(timeout=0.1)
-            if batch is None:
-                continue
-            n = int(batch["priorities"].shape[0])
-            self._ingest_one(batch, n)
-        # ship any staged full blocks; the partial tail is dropped and
-        # counted (single-chip and mesh alike — see _flush_stage)
-        self._flush_stage(force=True)
+        self.obs.register("ingest")
+        try:
+            while not self.stop_event.is_set():
+                self.obs.beat("ingest")
+                batch = self.transport.recv_experience(timeout=0.1)
+                if batch is None:
+                    continue
+                n = int(batch["priorities"].shape[0])
+                self._ingest_one(batch, n)
+            # ship any staged full blocks; the partial tail is dropped
+            # and counted (single-chip and mesh alike — _flush_stage)
+            self._flush_stage(force=True)
+        finally:
+            self.obs.clear("ingest")
 
     def _ingest_one(self, batch: dict, n: int) -> None:
         # sequence batches carry fewer items than env frames; actors ship
@@ -401,7 +426,8 @@ class ApexDriver:
                      if k != "priorities"}
             pris = jnp.asarray(take["priorities"])
         with self._state_lock:
-            self.state = self.learner.add(self.state, items, pris)
+            with self.obs.span("replay.add", units=count):
+                self.state = self.learner.add(self.state, items, pris)
         with self._lock:
             self._replay_filled = min(
                 self._replay_filled + count * self._unit_items,
@@ -488,10 +514,15 @@ class ApexDriver:
                 lambda t: jnp.zeros((self._stage_chunk,) + t.shape,
                                     t.dtype), self._item_spec)
             pris = jnp.zeros((self._stage_chunk,) + ptail, jnp.float32)
-        cls.add.lower(learner, self.state, example, pris).compile()
-        cls.train_step.lower(learner, self.state).compile()
+        c_add = cls.add.lower(learner, self.state, example,
+                              pris).compile()
+        c_step = cls.train_step.lower(learner, self.state).compile()
+        self.obs.log_compiled("add", c_add)
+        self.obs.log_compiled("train_step", c_step)
         if chunk > 1:
-            cls.train_many.lower(learner, self.state, chunk).compile()
+            c_many = cls.train_many.lower(learner, self.state,
+                                          chunk).compile()
+            self.obs.log_compiled("train_many", c_many)
         # the inference server's first forward compile otherwise exceeds
         # the actor query timeout on TPU (observed live); vector actors
         # hit the envs_per_actor bucket on their very first query. A
@@ -504,12 +535,14 @@ class ApexDriver:
                 extra_sizes=(self.cfg.actors.envs_per_actor,))
 
     def _learner_loop(self, max_grad_steps: int) -> None:
+        self.obs.register("learner")
         try:
             self._learner_loop_inner(max_grad_steps)
         except Exception as e:
             with self._lock:
                 self.loop_errors.append(("learner", e))
         finally:
+            self.obs.clear("learner")
             # an exception mid-capture must still flush the trace (and
             # release the process-wide profiler for any later run)
             if self._profiling:
@@ -522,7 +555,8 @@ class ApexDriver:
         # Dist publication is a tp all-gather + replication over ICI
         # (SURVEY.md §2.3 item 3); single-chip learners copy.
         with self._state_lock:
-            pub = self.learner.publish_params(self.state)
+            with self.obs.span("learner.publish_params"):
+                pub = self.learner.publish_params(self.state)
         self.server.update_params(pub, self._grad_steps_total)
         # remote actor hosts pull the same copy through the transport's
         # param channel (socket_transport serves it over DCN)
@@ -555,8 +589,10 @@ class ApexDriver:
         last_log = 0
         last_ckpt = self._grad_steps_total
         cap = self.cfg.learner.steps_per_frame_cap
+        sync_every = self.cfg.learner.target_sync_every
         while (not self.stop_event.is_set()
                and self._grad_steps_total < max_grad_steps):
+            self.obs.beat("learner")
             with self._lock:
                 filled = self._replay_filled
                 frames = self._frames_total
@@ -567,6 +603,7 @@ class ApexDriver:
                 time.sleep(0.01)  # pacing: let actors catch up
                 continue
             self._maybe_profile()
+            self.obs.maybe_profile(self._grad_steps_total)
             # fuse up to `chunk` grad-steps into one device dispatch
             # (lax.scan in learner.train_many) without overshooting the
             # step target; k is snapped to {chunk, 1} so exactly two XLA
@@ -581,12 +618,27 @@ class ApexDriver:
             done = self._grad_steps_total
             k = chunk if chunk <= max_grad_steps - done else 1
             with self._state_lock:
-                if k > 1:
-                    self.state, m = self.learner.train_many(self.state, k)
-                else:
-                    self.state, m = self.learner.train_step(self.state)
+                with self.obs.span("learner.train", k=k):
+                    if k > 1:
+                        self.state, m = self.learner.train_many(
+                            self.state, k)
+                    else:
+                        self.state, m = self.learner.train_step(self.state)
+                    if self.obs.enabled:
+                        # honest host timing under async dispatch; only
+                        # paid when observability is on
+                        m = jax.block_until_ready(m)
             self._grad_steps_total += k
             self.grad_steps.add(k)
+            self.obs.set_learner_step(self._grad_steps_total)
+            # sampling + priority write-back + (boundary permitting) the
+            # target sync are fused inside the train jit: mark, don't span
+            self.obs.mark("replay.sample", fused_into="learner.train")
+            self.obs.mark("replay.priority_update",
+                          fused_into="learner.train")
+            if done // sync_every != self._grad_steps_total // sync_every:
+                self.obs.mark("learner.target_sync",
+                              fused_into="learner.train")
             if done // publish_every != self._grad_steps_total // publish_every:
                 self._publish_params()
             if (self.ckpt is not None and self._grad_steps_total - last_ckpt
@@ -618,6 +670,10 @@ class ApexDriver:
                     replay_size=replay_size,
                     ingest_dropped=self.transport.dropped,
                     **extra)
+                if "td_abs_mean" in m:
+                    self.obs.observe("td_abs", float(m["td_abs_mean"]))
+                self.obs.gauge("replay_occupancy", replay_size)
+                self.obs.publish(self._grad_steps_total)
         # NOTE: a capture still open here (short run ending inside the
         # profile window) is closed by _learner_loop's finally
 
@@ -726,6 +782,11 @@ class ApexDriver:
         try:
             prev_stuck_at = -1  # _ingested_batches at last stuck sighting
             while True:
+                # attributed stall error instead of a silent hang: the
+                # poll loop is the one thread guaranteed alive while a
+                # worker wedges, so the watchdog raises HERE and the
+                # finally-teardown below still runs
+                self.obs.check_stalled()
                 if (wall_clock_limit_s is not None
                         and time.monotonic() - t0 > wall_clock_limit_s):
                     break
@@ -825,6 +886,9 @@ class ApexDriver:
                 except Exception as e:
                     self.loop_errors.append(("checkpoint", e))
             self.server.stop()
+            # final snapshot + trace flush (idempotent: the stall path
+            # already closed inside check_stalled before raising)
+            self.obs.close(self._grad_steps_total)
         with self._lock:
             avg_ret = (float(np.mean(self.episode_returns))
                        if self.episode_returns else 0.0)

@@ -52,6 +52,12 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.configs import RunConfig
+# StallWatchdog moved to the observability layer (obs/health.py) so the
+# single-host heartbeat watchdog and this lockstep watchdog live
+# together; re-exported here because tests and operational docs import
+# it from this module.
+from ape_x_dqn_tpu.obs.health import StallWatchdog  # noqa: F401
+from ape_x_dqn_tpu.obs.core import build_obs
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.parallel.dist_learner import (
@@ -72,70 +78,6 @@ from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
 
 
-class StallWatchdog:
-    """Surfaces collective hangs (round-2 verdict weak #8): a peer
-    process dying mid-round leaves every survivor blocked inside a
-    collective with no error — the documented NCCL-equivalent failure
-    domain. This host-local daemon watches a progress stamp the round
-    loop bumps; after `timeout_s` of silence it emits a diagnostic
-    (which process, how long, what the loop last reported), and after
-    TWO consecutive silent windows calls `fatal` (default os._exit) so
-    the job-level restart-from-checkpoint recovery actually triggers
-    instead of the fleet hanging until a human or scheduler notices.
-
-    Purely host-local: it never issues collectives, so it cannot
-    perturb the lockstep call sequence."""
-
-    def __init__(self, timeout_s: float, describe, fatal=None,
-                 emit=None):
-        """describe() -> str: host-local state for the diagnostic.
-        fatal/emit injectable for tests."""
-        import os as _os
-        self.timeout_s = timeout_s
-        self._describe = describe
-        self._fatal = fatal or (lambda code: _os._exit(code))
-        self._emit = emit or (lambda msg: print(msg, file=sys.stderr,
-                                                flush=True))
-        self._stamp = time.monotonic()
-        self._stop = threading.Event()
-        self._fired = 0
-        self._thread = threading.Thread(target=self._watch,
-                                        name="stall-watchdog",
-                                        daemon=True)
-
-    def start(self) -> None:
-        if self.timeout_s > 0:
-            self._thread.start()
-
-    def stamp(self) -> None:
-        self._stamp = time.monotonic()
-        self._fired = 0
-
-    def stop(self) -> None:
-        self._stop.set()
-
-    def _watch(self) -> None:
-        poll = min(self.timeout_s / 4, 10.0)
-        while not self._stop.wait(poll):
-            silent = time.monotonic() - self._stamp
-            if silent < self.timeout_s:
-                continue
-            self._fired += 1
-            self._emit(
-                f"[stall-watchdog] process {jax.process_index()}: no "
-                f"round progress for {silent:.0f}s (timeout "
-                f"{self.timeout_s:.0f}s, strike {self._fired}/2) — a "
-                f"peer process has likely died inside a collective. "
-                f"State: {self._describe()}")
-            if self._fired >= 2:
-                self._emit(
-                    f"[stall-watchdog] process {jax.process_index()}: "
-                    f"aborting so the job restarts from the latest "
-                    f"checkpoint (the hung collective cannot be "
-                    f"recovered in-process)")
-                self._fatal(70)
-                return
-            self._stamp = time.monotonic()  # strike window restarts
 
 
 class MultihostApexDriver:
@@ -194,6 +136,11 @@ class MultihostApexDriver:
                 "single-process with remote actor hosts "
                 "(runtime/actor_host.py)")
         self.metrics = metrics or Metrics()
+        # observability facade (obs/): spans around the collective
+        # round stages + per-publish instrument snapshots; NULL_OBS
+        # unless cfg.obs.enabled. The round-progress StallWatchdog
+        # below is collective-aware and stays the stall authority here.
+        self.obs = build_obs(getattr(cfg, "obs", None), self.metrics)
         probe_env = make_env(cfg.env, seed=cfg.seed)
         self.spec = probe_env.spec
         self.net = build_network(cfg.network, self.spec)
@@ -264,7 +211,7 @@ class MultihostApexDriver:
             server_apply_fn(self.family, self.net), server_params,
             max_batch=cfg.inference.max_batch,
             deadline_ms=cfg.inference.deadline_ms,
-            mesh=self._inference_mesh)
+            mesh=self._inference_mesh, obs=self.obs)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
         self.transport.publish_params(server_params, 0)
@@ -350,8 +297,9 @@ class MultihostApexDriver:
         # process-0-only call would deadlock the others; the payload is
         # replicated host numpy, which orbax writes once from the
         # primary process
-        payload = self._ckpt_payload()  # collective: all processes
-        self.ckpt.save(self._grad_steps, payload, wait=wait)
+        with self.obs.span("ckpt.save", step=self._grad_steps):
+            payload = self._ckpt_payload()  # collective: all processes
+            self.ckpt.save(self._grad_steps, payload, wait=wait)
 
     def _restore_leaf(self, x, ref):
         """Host numpy -> global array with ref's sharding (the callback
@@ -455,7 +403,7 @@ class MultihostApexDriver:
             actor = actor_class(self.family, vector=vector)(
                 acfg, jax.process_index() * n_local + i,
                 query, self.transport,
-                episode_callback=self._on_episode)
+                episode_callback=self._on_episode, obs=self.obs)
             actor.run(max_frames, self.stop_event)
         except Exception as e:  # noqa: BLE001 - reported in run() output
             with self._lock:
@@ -730,14 +678,17 @@ class MultihostApexDriver:
                 watchdog.stamp()
                 # 1. collective ingest, gated on EVERY host having a block
                 if all_ready:
-                    block = self._pop_block()
-                    items = multihost.make_global(
-                        self.mesh,
-                        {k: v for k, v in block.items() if k != "priorities"})
-                    pris = multihost.make_global(self.mesh,
-                                                 block["priorities"])
-                    self.state = self.learner.add(self.state, items, pris)
-                    filled = int(global_size(self.state))
+                    with self.obs.span("replay.add"):
+                        block = self._pop_block()
+                        items = multihost.make_global(
+                            self.mesh,
+                            {k: v for k, v in block.items()
+                             if k != "priorities"})
+                        pris = multihost.make_global(self.mesh,
+                                                     block["priorities"])
+                        self.state = self.learner.add(self.state, items,
+                                                      pris)
+                        filled = int(global_size(self.state))
                     progressed = True
                 # 2. lockstep training, branch on global values only.
                 # steps_per_frame_cap paces the learner to the GLOBAL
@@ -757,15 +708,25 @@ class MultihostApexDriver:
                     done = self._grad_steps
                     k = chunk_steps if chunk_steps <= \
                         max_grad_steps - done else 1
-                    self.state, m = self.learner.train_many(self.state, k)
+                    with self.obs.span("learner.train", k=k):
+                        self.state, m = self.learner.train_many(self.state,
+                                                                k)
+                        loss = float(m["loss"])  # blocks: honest timing
                     self._grad_steps += k
-                    loss = float(m["loss"])
+                    self.obs.set_learner_step(self._grad_steps)
+                    self.obs.mark("replay.sample",
+                                  fused_into="learner.train")
+                    self.obs.mark("replay.priority_update",
+                                  fused_into="learner.train")
                     progressed = True
                     if done // publish_every != \
                             self._grad_steps // publish_every:
-                        pub = self._host_params()
-                        self.server.update_params(pub, self._grad_steps)
-                        self.transport.publish_params(pub, self._grad_steps)
+                        with self.obs.span("learner.publish_params"):
+                            pub = self._host_params()
+                            self.server.update_params(pub,
+                                                      self._grad_steps)
+                            self.transport.publish_params(
+                                pub, self._grad_steps)
                         with self._lock:
                             returns = list(self.episode_returns)
                         self.metrics.log(
@@ -774,6 +735,8 @@ class MultihostApexDriver:
                             frames_local=frames_local,
                             avg_return=(float(np.mean(returns))
                                         if returns else None))
+                        self.obs.gauge("replay_occupancy", filled)
+                        self.obs.publish(self._grad_steps)
                 # checkpoint on a grad-step cadence: _grad_steps is a
                 # global value, so every process enters the collective
                 # payload gather on the same round
@@ -818,6 +781,7 @@ class MultihostApexDriver:
             watchdog.stop()
             self.stop_event.set()
             self.server.stop()
+            self.obs.close(self._grad_steps)
             raise
 
         # final checkpoint BEFORE joining actors: the break is lockstep
@@ -862,6 +826,7 @@ class MultihostApexDriver:
             except Exception as e:  # noqa: BLE001
                 self._eval_error = e
         self.server.stop()
+        self.obs.close(self._grad_steps)
         with self._lock:
             avg_ret = (float(np.mean(self.episode_returns))
                        if self.episode_returns else 0.0)

@@ -21,6 +21,7 @@ import numpy as np
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.obs.core import build_obs
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder
 from ape_x_dqn_tpu.replay.prioritized import (
     PrioritizedReplay, UniformReplayDevice)
@@ -48,6 +49,11 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
     total = total_env_frames or cfg.total_env_frames
     metrics = metrics or Metrics()
     log_run_header(metrics, cfg)
+    # `obs` is this loop's env observation; the observability facade
+    # rides as `obs_` (NULL_OBS when cfg.obs is absent/disabled)
+    obs_ = build_obs(getattr(cfg, "obs", None), metrics)
+    obs_.register("actor-0")
+    obs_.register("learner")
     env = make_env(cfg.env, seed=cfg.seed)
     net = build_network(cfg.network, env.spec)
 
@@ -56,6 +62,10 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
     fwd = jax.jit(net.apply)
 
     replay = build_replay(cfg.replay)
+    # host mirror of the ring's skip-to-head write cursor: maps sampled
+    # slot indices back to the grad-step they were written at (None
+    # when obs is disabled)
+    age_tracker = obs_.age_tracker(next_pow2(cfg.replay.capacity))
     item_spec = transition_item_spec(env.spec.obs_shape,
                                      env.spec.obs_dtype)
     learner = DQNLearner(net.apply, replay, cfg.learner)
@@ -97,26 +107,65 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
         nonlocal pending, state
         if not pending:
             return
-        items = {
-            "obs": jnp.asarray(np.stack([t.obs for t in pending])),
-            "action": jnp.asarray([t.action for t in pending], jnp.int32),
-            "reward": jnp.asarray([t.reward for t in pending], jnp.float32),
-            "next_obs": jnp.asarray(np.stack([t.next_obs for t in pending])),
-            "discount": jnp.asarray([t.discount for t in pending],
-                                    jnp.float32),
-        }
-        state = learner.add(state, items, jnp.ones(len(pending)))
+        with obs_.span("replay.add", n=len(pending)):
+            items = {
+                "obs": jnp.asarray(np.stack([t.obs for t in pending])),
+                "action": jnp.asarray([t.action for t in pending],
+                                      jnp.int32),
+                "reward": jnp.asarray([t.reward for t in pending],
+                                      jnp.float32),
+                "next_obs": jnp.asarray(
+                    np.stack([t.next_obs for t in pending])),
+                "discount": jnp.asarray([t.discount for t in pending],
+                                        jnp.float32),
+            }
+            state = learner.add(state, items, jnp.ones(len(pending)))
+        if age_tracker is not None:
+            age_tracker.on_add(len(pending), grad_steps)
+        obs_.count("replay_adds", len(pending))
         pending = []
 
+    def traced_train(k: int):
+        """Observed macro-step: the split sample_k/learn_k dispatch
+        (parity-tested against train_step/_k in PR 1) so the tracer
+        sees replay.sample and learner.learn as real host spans —
+        block_until_ready inside each span keeps the timing honest
+        against jax's async dispatch. Priority write-back and target
+        sync are fused inside the learn jit, so they ride as marks."""
+        nonlocal state
+        with obs_.span("replay.sample", k=k):
+            sample, rng2 = learner.sample_k(state, k)
+            jax.block_until_ready(sample)
+        if age_tracker is not None:
+            obs_.observe_sample_ages(
+                age_tracker.ages(np.asarray(sample[1]), grad_steps))
+        with obs_.span("learner.learn", k=k):
+            state, m = learner.learn_k(state._replace(rng=rng2),
+                                       sample, k)
+            m = jax.block_until_ready(m)
+        obs_.mark("replay.priority_update", fused_into="learner.learn")
+        sync = cfg.learner.target_sync_every
+        if grad_steps // sync != (grad_steps + k) // sync:
+            obs_.mark("learner.target_sync", fused_into="learner.learn")
+        obs_.observe("td_abs", float(m["td_abs_mean"]))
+        # the acting policy reads state.params directly — lag is truly 0
+        obs_.observe("param_lag_steps", 0)
+        return m
+
+    pub_every = max(getattr(getattr(cfg, "obs", None),
+                            "publish_every_steps", 500) or 500, 1)
     while frames < total:
+        obs_.beat("actor-0", f"frame {frames}")
         eps = max(eps_final, 1.0 - (1.0 - eps_final) * frames
                   / eps_decay_frames)
-        if actor_rng.random() < eps:
-            action = int(actor_rng.integers(env.spec.num_actions))
-        else:
-            q = fwd(state.params, obs[None])
-            action = int(jnp.argmax(q[0]))
-        next_obs, reward, done, info = env.step(action)
+        with obs_.span("actor.step"):
+            if actor_rng.random() < eps:
+                action = int(actor_rng.integers(env.spec.num_actions))
+            else:
+                with obs_.span("actor.inference"):
+                    q = fwd(state.params, obs[None])
+                action = int(jnp.argmax(q[0]))
+            next_obs, reward, done, info = env.step(action)
         frames += 1
         truncated = done and not info.get("terminal", done)
         pending.extend(nstep.append(obs, action, reward, next_obs,
@@ -140,7 +189,13 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
                 train_bank += 1
                 if train_bank >= sample_chunk:
                     train_bank = 0
-                    if sample_prefetch:
+                    if obs_.enabled:
+                        # observed runs take the split dispatch so the
+                        # sample/learn stages are separately timeable;
+                        # the prefetch overlap is deliberately broken
+                        # here — honest stage timing needs the sync
+                        m = traced_train(sample_chunk)
+                    elif sample_prefetch:
                         if pending_sample is None:  # pipeline prologue
                             pending_sample, rng2 = learner.sample_k(
                                 state, sample_chunk)
@@ -155,9 +210,14 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
                                                         sample_chunk)
                     grad_steps += sample_chunk
             else:
-                state, m = learner.train_step(state)
+                if obs_.enabled:
+                    m = traced_train(1)
+                else:
+                    state, m = learner.train_step(state)
                 grad_steps += 1
             if m is not None:
+                obs_.beat("learner", f"grad_step {grad_steps}")
+                obs_.maybe_profile(grad_steps)
                 losses.append(float(m["loss"]))
                 # boundary CROSSING, not equality: K-sized increments
                 # would otherwise only hit exact multiples at lcm(K, 500)
@@ -168,10 +228,20 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
                                 avg_return=(float(np.mean(returns))
                                             if returns else 0.0),
                                 eps=eps)
+                if prev_grad_steps // pub_every != \
+                        grad_steps // pub_every:
+                    obs_.gauge("replay_occupancy",
+                               int(state.replay.size))
+                    obs_.publish(grad_steps)
+        obs_.check_stalled()
         if (solve_return is not None and len(returns) >= 20
                 and np.mean(list(returns)[-20:]) >= solve_return):
             break
 
+    # final snapshot + trace flush (the stall path closes inside
+    # check_stalled before raising, so both exits produce artifacts)
+    obs_.gauge("replay_occupancy", int(state.replay.size))
+    obs_.close(grad_steps)
     return {
         "frames": frames,
         "grad_steps": grad_steps,

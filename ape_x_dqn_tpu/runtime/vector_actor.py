@@ -37,6 +37,7 @@ import numpy as np
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.envs.vector import SyncVectorEnv
+from ape_x_dqn_tpu.obs.core import NULL_OBS
 from ape_x_dqn_tpu.ops.nstep import NStepBuilder, NStepTransition
 from ape_x_dqn_tpu.replay.frame_ring import FrameSegmentBuilder
 from ape_x_dqn_tpu.runtime.actor import (
@@ -74,11 +75,14 @@ class VectorActor(DiscretePolicyHooks):
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn: Callable[[np.ndarray, int], np.ndarray],
                  transport, seed: int | None = None,
-                 episode_callback: Callable[[int, dict], None] | None = None):
+                 episode_callback: Callable[[int, dict], None] | None = None,
+                 obs: object | None = None):
         self.cfg = cfg
         self.index = actor_index
         self.query = query_fn
         self.transport = transport
+        self.obs = obs if obs is not None else NULL_OBS
+        self._hb = f"actor-{actor_index}"
         seed = cfg.seed if seed is None else seed
         self.K = max(cfg.actors.envs_per_actor, 1)
         total_slots = cfg.actors.num_actors * self.K
@@ -159,7 +163,9 @@ class VectorActor(DiscretePolicyHooks):
                 core.seg.on_reset(obs[j])
         while self.frames < max_frames and not (
                 stop_event is not None and stop_event.is_set()):
-            out = self.query(obs, self.K)
+            self.obs.beat(self._hb)
+            with self.obs.span("actor.inference", k=self.K):
+                out = self.query(obs, self.K)
             outs = _split(out, self.K)
             actions = []
             for j, core in enumerate(self.cores):
@@ -260,13 +266,15 @@ class RecurrentVectorActor:
 
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn, transport, seed: int | None = None,
-                 episode_callback=None):
+                 episode_callback=None, obs: object | None = None):
         from ape_x_dqn_tpu.replay.sequence import SequenceBuilder
 
         self.cfg = cfg
         self.index = actor_index
         self.query = query_fn
         self.transport = transport
+        self.obs = obs if obs is not None else NULL_OBS
+        self._hb = f"actor-{actor_index}"
         seed = cfg.seed if seed is None else seed
         self.K = max(cfg.actors.envs_per_actor, 1)
         self.gamma = cfg.learner.gamma
@@ -329,10 +337,12 @@ class RecurrentVectorActor:
         obs = self.venv.reset()
         while self.frames < max_frames and not (
                 stop_event is not None and stop_event.is_set()):
-            out = self.query({
-                "obs": obs,
-                "c": np.stack([c.c for c in self.cores]),
-                "h": np.stack([c.h for c in self.cores])}, self.K)
+            self.obs.beat(self._hb)
+            with self.obs.span("actor.inference", k=self.K):
+                out = self.query({
+                    "obs": obs,
+                    "c": np.stack([c.c for c in self.cores]),
+                    "h": np.stack([c.h for c in self.cores])}, self.K)
             q, cs, hs = (np.asarray(out["q"]), np.asarray(out["c"]),
                          np.asarray(out["h"]))
             actions = []
@@ -429,7 +439,7 @@ class ContinuousVectorActor(ContinuousPolicyHooks, VectorActor):
 
     def __init__(self, cfg: RunConfig, actor_index: int,
                  query_fn, transport, seed: int | None = None,
-                 episode_callback=None):
+                 episode_callback=None, obs: object | None = None):
         super().__init__(cfg, actor_index, query_fn, transport, seed=seed,
-                         episode_callback=episode_callback)
+                         episode_callback=episode_callback, obs=obs)
         self._init_noise(cfg)

@@ -232,3 +232,29 @@ def check_hbm_fits(cfg: Any, obs_shape: tuple[int, ...], obs_dtype=np.uint8,
             f"wider, or switch replay.storage='frame_ring' for pixel "
             f"configs.")
     return budget
+
+
+def compiled_memory_summary(compiled: Any) -> dict[str, int] | None:
+    """XLA memory_analysis() of a compiled jit as a plain int dict —
+    the MEASURED per-graph numbers the static budget above is
+    calibrated against (module docstring "measured anchors"). The obs
+    layer logs these per warmed jit (Obs.log_compiled) so every run's
+    JSONL records what its graphs actually reserve. None when the
+    backend exposes no analysis (some CPU builds)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for field_name, key in (
+            ("argument_size_in_bytes", "arg_bytes"),
+            ("output_size_in_bytes", "out_bytes"),
+            ("temp_size_in_bytes", "temp_bytes"),
+            ("alias_size_in_bytes", "alias_bytes"),
+            ("generated_code_size_in_bytes", "code_bytes")):
+        v = getattr(ma, field_name, None)
+        if v is not None:
+            out[key] = int(v)
+    return out or None

@@ -50,7 +50,10 @@ class Throughput:
 
     @property
     def total(self) -> float:
-        return self._total
+        # locked: add() mutates _total from producer threads while
+        # drivers read totals from the supervisory loop
+        with self._lock:
+            return self._total
 
 
 class Metrics:
@@ -89,7 +92,9 @@ class Metrics:
     def log(self, step: int, **scalars: Any) -> None:
         rec = {"step": int(step), "time": time.time()}
         for k, v in scalars.items():
-            if hasattr(v, "__float__"):
+            if isinstance(v, bool):
+                pass  # JSON booleans stay booleans (flags in headers)
+            elif hasattr(v, "__float__"):
                 v = float(v)
                 # keep the JSONL strictly parseable even when training
                 # diverges (NaN/Inf are not valid JSON)
@@ -130,10 +135,18 @@ def log_run_header(metrics: "Metrics", cfg: Any, step: int = 0) -> None:
     silent about which semantics it recorded (round-4 verdict weak #6).
     Every driver calls this once before its first training record.
     """
+    from ape_x_dqn_tpu import __version__
+
     metrics.log(
         step,
         run_name=cfg.name,
+        version=__version__,
         sample_chunk=max(getattr(cfg.learner, "sample_chunk", 1) or 1, 1),
+        # PR 1's double-buffered pipeline changes sampling semantics
+        # (one-dispatch priority staleness) — a JSONL must say whether
+        # its numbers were produced with the pipeline on
+        sample_prefetch=bool(getattr(cfg.learner, "sample_prefetch",
+                                     False)),
         replay_kind=cfg.replay.kind,
         replay_storage=cfg.replay.storage,
         replay_capacity=cfg.replay.capacity,
@@ -180,6 +193,16 @@ ATARI_HUMAN_RANDOM: dict[str, tuple[float, float]] = {
 
 
 def human_normalized_score(game: str, score: float) -> float:
+    if game not in ATARI_HUMAN_RANDOM:
+        import difflib
+        close = difflib.get_close_matches(game, ATARI_HUMAN_RANDOM,
+                                          n=3, cutoff=0.4)
+        hint = (f"; closest valid keys: {close}" if close
+                else "; valid keys are snake_case ALE game names "
+                     "(e.g. 'space_invaders')")
+        raise ValueError(
+            f"unknown Atari game {game!r} for the human-normalized "
+            f"score table{hint}")
     rand, human = ATARI_HUMAN_RANDOM[game]
     return (score - rand) / (human - rand)
 

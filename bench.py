@@ -230,6 +230,41 @@ def bench_learner(learner, state, steps_per_dispatch: int,
     return rates, state
 
 
+def bench_stage_breakdown(learner, state, k: int, iters: int = 16,
+                          repeats: int = 3) -> tuple[dict, object]:
+    """Sample vs learn split of one macro-step, host-timed through the
+    split sample_k/learn_k jits — the same dispatch the observability
+    layer's traced path takes (obs/report.py prints the live-run twin
+    of this number from span/replay.sample + span/learner.learn).
+    block_until_ready inside each stage keeps the split honest against
+    async dispatch; the fused train_many number above stays the
+    throughput authority (the split forgoes overlap by construction)."""
+    # warm both jits at this (state, k) signature
+    sample, rng = learner.sample_k(state, k)
+    jax.block_until_ready(sample)
+    state, m = learner.learn_k(state._replace(rng=rng), sample, k)
+    jax.block_until_ready(m["loss"])
+    samp_ms, learn_ms = [], []
+    for _ in range(repeats):
+        ts = tl = 0.0
+        for _ in range(iters):
+            t0 = time.monotonic()
+            sample, rng = learner.sample_k(state, k)
+            jax.block_until_ready(sample)
+            ts += time.monotonic() - t0
+            t0 = time.monotonic()
+            state, m = learner.learn_k(state._replace(rng=rng), sample, k)
+            jax.block_until_ready(m["loss"])
+            tl += time.monotonic() - t0
+        samp_ms.append(1000.0 * ts / iters)
+        learn_ms.append(1000.0 * tl / iters)
+    log(f"stage breakdown (split sample_k/learn_k, k={k}): sample "
+        f"{spread(samp_ms)} ms vs learn {spread(learn_ms)} ms "
+        f"per macro-step")
+    return ({"sample_ms": spread(samp_ms), "learn_ms": spread(learn_ms),
+             "k": k}, state)
+
+
 def train_step_flops_xla(learner, state,
                          steps_per_dispatch: int) -> float | None:
     """XLA's own FLOP count for one fused grad-step (compiler cost
@@ -610,6 +645,9 @@ def main() -> None:
                                      args.steps_per_dispatch)
     if xla_flops is not None:
         secondary["flops_per_step_xla"] = round(xla_flops)
+    sb, state = bench_stage_breakdown(learner, state, args.sample_chunk,
+                                      repeats=args.repeats)
+    secondary["stage_breakdown"] = sb
     state, add_rates = bench_add_device(learner, state, spec, args.storage)
     secondary["device_add_transitions_per_s"] = spread(add_rates)
     inf_rates = bench_inference(net, spec, repeats=args.repeats)
