@@ -4,6 +4,12 @@ Compiled lazily via utils/native_build.py; if no compiler is available
 the pure-Python fallbacks (zlib.crc32 + bytes joins) are
 wire-compatible, so a C++-enabled learner host can talk to a
 Python-only actor host.
+
+Every entry point accepts bytes, bytearray, or (1-D, contiguous)
+memoryview without copying: the ingest hot path hands `socket.recv_into`
+buffers and numpy array views straight through, so the only per-message
+copy left is the wire->staging landing itself (see
+socket_transport.decode_batch_into).
 """
 
 from __future__ import annotations
@@ -22,6 +28,8 @@ _SO = os.path.join(os.path.dirname(_SRC), "libapex_framing.so")
 _lib: ctypes.CDLL | None = None
 _tried = False
 
+Buffer = bytes | bytearray | memoryview
+
 
 def _load() -> ctypes.CDLL | None:
     # module-level cache: the codec runs per ingest message; don't
@@ -32,8 +40,10 @@ def _load() -> ctypes.CDLL | None:
     lib = build_and_load(_SRC, _SO)
     if lib is not None:
         try:
+            # c_void_p (not c_char_p) for the data pointers so writable
+            # buffers (bytearray, numpy views) pass without a bytes copy
             lib.apex_crc32.restype = ctypes.c_uint32
-            lib.apex_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+            lib.apex_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                                        ctypes.c_uint32]
             lib.apex_pack.restype = ctypes.c_uint64
             lib.apex_pack.argtypes = [
@@ -42,7 +52,7 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
             lib.apex_unpack_offsets.restype = ctypes.c_uint64
             lib.apex_unpack_offsets.argtypes = [
-                ctypes.c_char_p, ctypes.c_uint64,
+                ctypes.c_void_p, ctypes.c_uint64,
                 ctypes.POINTER(ctypes.c_uint64),
                 ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
         except AttributeError:
@@ -55,58 +65,116 @@ def have_native() -> bool:
     return _load() is not None
 
 
-def crc32(data: bytes | memoryview, seed: int = 0) -> int:
+def _addr(data: Buffer) -> tuple[ctypes.c_void_p, int, object]:
+    """(pointer, length, keepalive) for a bytes-like object, copy-free
+    where the buffer protocol allows it. The keepalive object must stay
+    referenced for the duration of the native call."""
+    if isinstance(data, bytes):
+        return (ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p),
+                len(data), data)
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    if not mv.contiguous:
+        b = mv.tobytes()  # non-contiguous: copy is unavoidable
+        return (ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p),
+                len(b), b)
+    n = mv.nbytes
+    if n == 0:
+        return ctypes.c_void_p(0), 0, mv
+    if mv.readonly:
+        # ctypes' from_buffer needs a writable buffer; a readonly view
+        # over bytes already has a stable address via the bytes object
+        obj = mv.obj
+        if isinstance(obj, bytes) and len(obj) == n:
+            return (ctypes.cast(ctypes.c_char_p(obj), ctypes.c_void_p),
+                    n, obj)
+        b = mv.tobytes()
+        return (ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p),
+                len(b), b)
+    arr = (ctypes.c_ubyte * n).from_buffer(mv)
+    return ctypes.cast(arr, ctypes.c_void_p), n, (arr, mv)
+
+
+def crc32(data: Buffer, seed: int = 0) -> int:
     lib = _load()
     if lib is None:
-        return zlib.crc32(bytes(data), seed) & 0xFFFFFFFF
-    buf = bytes(data) if isinstance(data, memoryview) else data
-    return int(lib.apex_crc32(buf, len(buf), seed))
+        return zlib.crc32(data, seed) & 0xFFFFFFFF
+    ptr, n, _keep = _addr(data)
+    return int(lib.apex_crc32(ptr, n, seed))
 
 
-def pack_records(chunks: list[bytes]) -> bytes:
+def pack_records(chunks: list[Buffer]) -> bytes:
     """Gather chunks into one [u64 len][bytes]* frame (native memcpy)."""
     lib = _load()
     if lib is None:
         out = bytearray()
         for c in chunks:
-            out += len(c).to_bytes(8, "little") + c
+            mv = c if isinstance(c, (bytes, bytearray)) \
+                else memoryview(c).cast("B")
+            out += len(mv).to_bytes(8, "little") + mv
         return bytes(out)
-    total = sum(len(c) for c in chunks) + 8 * len(chunks)
-    dst = ctypes.create_string_buffer(total)
     n = len(chunks)
     srcs = (ctypes.c_void_p * n)()
     lens = (ctypes.c_uint64 * n)()
     # keep refs so the buffers stay alive across the call
     keep = []
+    total = 0
     for i, c in enumerate(chunks):
-        b = c if isinstance(c, bytes) else bytes(c)
-        keep.append(b)
-        srcs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
-        lens[i] = len(b)
+        ptr, ln, ka = _addr(c)
+        keep.append(ka)
+        srcs[i] = ptr
+        lens[i] = ln
+        total += ln + 8
+    dst = ctypes.create_string_buffer(total)
     wrote = lib.apex_pack(ctypes.cast(dst, ctypes.c_void_p), srcs, lens, n)
     assert wrote == total, (wrote, total)
     return dst.raw
 
 
-def unpack_records(frame: bytes, max_records: int = 4096) -> list[bytes]:
-    """Inverse of pack_records; raises ValueError on malformed frames."""
+def _unpack_offsets(frame: Buffer,
+                    max_records: int) -> list[tuple[int, int]]:
+    """[(offset, length)] per record — the shared walk behind both the
+    copying and memoryview unpack forms."""
     lib = _load()
     if lib is None:
         out, off = [], 0
-        ln = len(frame)
+        mv = frame if isinstance(frame, (bytes, bytearray)) \
+            else memoryview(frame).cast("B")
+        ln = len(mv)
         while off < ln:
             if off + 8 > ln:
                 raise ValueError("malformed frame")
-            rec = int.from_bytes(frame[off:off + 8], "little")
+            rec = int.from_bytes(mv[off:off + 8], "little")
             off += 8
             if off + rec > ln:
                 raise ValueError("malformed frame")
-            out.append(frame[off:off + rec])
+            out.append((off, rec))
             off += rec
         return out
     offs = (ctypes.c_uint64 * max_records)()
     lens = (ctypes.c_uint64 * max_records)()
-    n = lib.apex_unpack_offsets(frame, len(frame), offs, lens, max_records)
+    ptr, ln, _keep = _addr(frame)
+    n = lib.apex_unpack_offsets(ptr, ln, offs, lens, max_records)
     if n == ctypes.c_uint64(-1).value:
         raise ValueError("malformed frame")
-    return [frame[offs[i]:offs[i] + lens[i]] for i in range(n)]
+    return [(offs[i], lens[i]) for i in range(n)]
+
+
+def unpack_records(frame: Buffer, max_records: int = 4096) -> list[bytes]:
+    """Inverse of pack_records; raises ValueError on malformed frames."""
+    return [bytes(frame[o:o + ln])
+            for o, ln in _unpack_offsets(frame, max_records)]
+
+
+def unpack_records_mv(frame: Buffer,
+                      max_records: int = 4096) -> list[memoryview]:
+    """Zero-copy unpack: memoryview slices into `frame` itself. The
+    views alias the frame — the caller must keep the frame alive and
+    unmodified while they are in use (the ingest staging path copies
+    them into the staging block immediately; that landing is the ONE
+    copy per wire byte)."""
+    mv = memoryview(frame)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    return [mv[o:o + ln] for o, ln in _unpack_offsets(frame, max_records)]

@@ -59,22 +59,36 @@ _WARNED_BAD_BLOB = False
 
 
 def encode_batch(batch: dict) -> bytes:
-    """Experience dict (numpy arrays + scalars) -> framed payload."""
+    """Experience dict (numpy arrays + scalars) -> framed payload.
+
+    Already-contiguous arrays hand their buffer straight to
+    pack_records (which memcpys into the frame) — zero extra copies;
+    the old ascontiguousarray + tobytes() path copied every array
+    twice before the frame copy."""
     meta, arrays = [], []
     for k, v in batch.items():
         if isinstance(v, np.ndarray):
-            v = np.ascontiguousarray(v)
+            if not v.flags["C_CONTIGUOUS"]:
+                v = np.ascontiguousarray(v)
             meta.append({"k": k, "nd": True, "dt": v.dtype.str,
                          "sh": list(v.shape)})
-            arrays.append(v.tobytes())
+            arrays.append(memoryview(v).cast("B") if v.flags["WRITEABLE"]
+                          else v.tobytes())
         else:
             meta.append({"k": k, "nd": False, "v": v})
     return native.pack_records([json.dumps(meta).encode()] + arrays)
 
 
-def decode_batch(payload: bytes) -> dict:
-    recs = native.unpack_records(payload)
-    meta = json.loads(recs[0].decode())
+def _parse_payload(payload) -> tuple[list, list[memoryview]]:
+    """(meta, per-array memoryview records) of a wire payload — the
+    zero-copy front half shared by every decode form."""
+    recs = native.unpack_records_mv(payload)
+    meta = json.loads(bytes(recs[0]))
+    return meta, recs
+
+
+def decode_batch(payload) -> dict:
+    meta, recs = _parse_payload(payload)
     out: dict = {}
     i = 1
     for m in meta:
@@ -87,22 +101,169 @@ def decode_batch(payload: bytes) -> dict:
     return out
 
 
+def _decode_rows_into(meta: list, recs: list[memoryview], dest: dict,
+                      offset: int, start: int, limit: int) -> int:
+    """Land rows [start, start+k) of every array record directly in
+    dest[key][offset:offset+k] — ONE copy per wire byte, contiguous by
+    construction. Returns k (rows written). Wire arrays without a
+    matching dest key are skipped (the legacy stage likewise only read
+    the item keys it knew)."""
+    written = None
+    i = 1
+    for m in meta:
+        if not m["nd"]:
+            continue
+        rec, i = recs[i], i + 1
+        d = dest.get(m["k"])
+        if d is None:
+            continue
+        sh = m["sh"]
+        total = int(sh[0]) if sh else 0
+        k = max(min(limit, total - start), 0)
+        dt = np.dtype(m["dt"])
+        row = int(np.prod(sh[1:], dtype=np.int64))
+        src = np.frombuffer(rec, dtype=dt, count=k * row,
+                            offset=start * row * dt.itemsize)
+        d[offset:offset + k] = src.reshape((k, *sh[1:]))
+        written = k
+    return written or 0
+
+
+def decode_batch_into(payload, dest: dict, offset: int, start: int = 0,
+                      limit: int | None = None) -> tuple[int, int, dict]:
+    """Decode a wire experience payload DIRECTLY into preallocated
+    staging arrays at a write cursor.
+
+    dest maps array keys -> preallocated [cap, ...] numpy rows; rows
+    [start, start+k) of the batch land at dest[key][offset:offset+k],
+    where k = min(limit, rows-start). Returns (k, rows, scalars) —
+    scalars are the non-array entries (e.g. "frames", "actor"). Callers
+    split a batch across staging-buffer boundaries by calling again
+    with an advanced `start`."""
+    meta, recs = _parse_payload(payload)
+    rows = batch_rows_meta(meta)
+    if limit is None:
+        limit = rows
+    k = _decode_rows_into(meta, recs, dest, offset, start, limit)
+    scalars = {m["k"]: m["v"] for m in meta if not m["nd"]}
+    return k, rows, scalars
+
+
+def batch_rows_meta(meta: list) -> int:
+    """Staging units in a wire batch: priorities' leading dim (the
+    driver's unit count), falling back to the first array record."""
+    first = None
+    for m in meta:
+        if m["nd"]:
+            if first is None:
+                first = int(m["sh"][0]) if m["sh"] else 0
+            if m["k"] == "priorities":
+                return int(m["sh"][0])
+    return first or 0
+
+
+class WireBatch:
+    """A received experience payload, decoded lazily.
+
+    The ingest staging fast path (runtime/ingest.py) calls decode_into
+    to land the wire bytes straight in a staging block with one copy;
+    every other consumer (the multihost driver's stage, tests reading
+    the queue directly) treats it like the dict decode_batch used to
+    return — item access materializes arrays on demand and caches them.
+    Scalar metadata ("frames", "actor") and the row count come from the
+    JSON header alone, with no array copies."""
+
+    __slots__ = ("payload", "_meta", "_recs", "_arrays")
+
+    def __init__(self, payload):
+        self.payload = payload
+        self._meta: list | None = None
+        self._recs: list[memoryview] | None = None
+        self._arrays: dict = {}
+
+    def _parsed(self) -> tuple[list, list[memoryview]]:
+        if self._meta is None:
+            self._meta, self._recs = _parse_payload(self.payload)
+        return self._meta, self._recs
+
+    @property
+    def rows(self) -> int:
+        """Staging units in this batch (header-only, no array copies)."""
+        meta, _ = self._parsed()
+        return batch_rows_meta(meta)
+
+    def decode_into(self, dest: dict, offset: int, start: int = 0,
+                    limit: int | None = None) -> int:
+        """One-copy landing of rows [start, start+k) at dest[...][offset:].
+        Returns k. See decode_batch_into."""
+        meta, recs = self._parsed()
+        if limit is None:
+            limit = self.rows
+        return _decode_rows_into(meta, recs, dest, offset, start, limit)
+
+    def __getitem__(self, key):
+        if key in self._arrays:
+            return self._arrays[key]
+        meta, recs = self._parsed()
+        i = 1
+        for m in meta:
+            if m["nd"]:
+                if m["k"] == key:
+                    arr = np.frombuffer(
+                        recs[i], dtype=np.dtype(m["dt"])).reshape(
+                            m["sh"]).copy()
+                    self._arrays[key] = arr
+                    return arr
+                i += 1
+            elif m["k"] == key:
+                return m["v"]
+        raise KeyError(key)
+
+    def get(self, key, default=None):
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def keys(self):
+        meta, _ = self._parsed()
+        return [m["k"] for m in meta]
+
+    def __contains__(self, key) -> bool:
+        meta, _ = self._parsed()
+        return any(m["k"] == key for m in meta)
+
+
+def batch_rows(batch) -> int:
+    """Staging units in an ingest message, cheap for both forms: wire
+    batches read their JSON header; dict batches read priorities."""
+    if isinstance(batch, WireBatch):
+        return batch.rows
+    return int(batch["priorities"].shape[0])
+
+
 def _send_msg(sock: socket.socket, mtype: int, payload: bytes) -> None:
     hdr = _HDR.pack(MAGIC, mtype, native.crc32(payload), len(payload))
     sock.sendall(hdr + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray | None:
+    """Read exactly n bytes into ONE preallocated buffer via recv_into —
+    multi-MB experience frames land without per-chunk copies or
+    bytearray regrowth. Returns the bytearray itself (crc32, struct
+    unpack, and the record walk all take buffers, so no bytes() copy)."""
+    buf = bytearray(n)
+    mv = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(mv[got:], n - got)
+        if r == 0:
             return None
-        buf += chunk
-    return bytes(buf)
+        got += r
+    return buf
 
 
-def _recv_msg(sock: socket.socket) -> tuple[int, bytes] | None:
+def _recv_msg(sock: socket.socket) -> tuple[int, bytearray] | None:
     hdr = _recv_exact(sock, _HDR.size)
     if hdr is None:
         return None
@@ -155,6 +316,7 @@ class SocketIngestServer:
         self._bytes_out = 0
         self._params: tuple[Any, int] = (None, -1)
         self._params_blob: bytes | None = pickle.dumps((None, -1))
+        self._params_cache: tuple[Any, int] | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -207,6 +369,7 @@ class SocketIngestServer:
         with self._lock:
             self._params = (params, version)
             self._params_blob = None
+            self._params_cache = None
 
     def _param_blob(self) -> bytes:
         with self._lock:
@@ -220,8 +383,23 @@ class SocketIngestServer:
             return self._params_blob
 
     def get_params(self) -> tuple[Any, int]:
-        params, version = pickle.loads(self._param_blob())
-        return _upcast_bf16(params), version
+        """Local loopback callers get the deserialized tree directly,
+        cached per published version — no pickle round-trip per pull;
+        the pickled blob stays wire-only. The cache still holds the
+        BLOB-roundtripped values (bf16 wire rounding and all), so local
+        and remote pulls see bit-identical params."""
+        with self._lock:
+            if self._params_cache is not None:
+                return self._params_cache
+        blob = self._param_blob()
+        params, version = pickle.loads(blob)
+        out = (_upcast_bf16(params), version)
+        with self._lock:
+            # cache only if no newer publish invalidated the blob while
+            # we deserialized outside the lock
+            if self._params_blob is blob:
+                self._params_cache = out
+        return out
 
     @property
     def dropped(self) -> int:
@@ -327,7 +505,15 @@ class SocketIngestServer:
                     with self._conns_lock:
                         self._ever_connected = True
                         self._bytes_in += len(payload)
-                    self.send_experience(decode_batch(payload))
+                    # enqueue the payload with decode deferred (WireBatch):
+                    # the ingest thread lands the bytes straight in its
+                    # staging block with one copy instead of this reader
+                    # materializing a full dict of array copies per
+                    # message. Parse the header here so a corrupt frame
+                    # faults THIS connection, not the consumer.
+                    batch = WireBatch(payload)
+                    batch.rows  # noqa: B018 - framing validation
+                    self.send_experience(batch)
                 elif mtype == MSG_PARAMS_REQ:
                     blob = self._param_blob()
                     with self._conns_lock:

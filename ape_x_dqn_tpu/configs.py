@@ -79,6 +79,23 @@ class ReplayConfig:
     # priority = eta*max|td| + (1-eta)*mean|td| over the sequence
     priority_eta: float = 0.9
     min_fill: int = 50_000  # transitions before learning starts
+    # -- zero-copy pipelined ingest staging (runtime/ingest.py) --
+    # Wire batches decode DIRECTLY into preallocated fixed-shape staging
+    # blocks at a write cursor (one copy per wire byte, contiguous by
+    # construction — PERF.md round 5: contiguity is ~80 vs ~3,000
+    # items/s of device_put), double-buffered so block N+1 decodes while
+    # block N's async device_put is in flight.
+    # ingest_coalesce: staged blocks fused into ONE donated add_many
+    # dispatch — _state_lock is taken once per group instead of once per
+    # block, so ingest adds stop interleaving with train_many. Latency
+    # cost: a group buffers coalesce * block units host-side before
+    # shipping (idle drains flush partial groups block-by-block).
+    ingest_coalesce: int = 4
+    # host staging buffers to rotate through (>= 2 for double buffering)
+    stage_buffers: int = 2
+    # compat escape hatch: False restores the list-append +
+    # concatenate-per-flush legacy staging path in runtime/driver.py
+    ingest_zero_copy: bool = True
 
 
 @dataclass(frozen=True)

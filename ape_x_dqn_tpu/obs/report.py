@@ -70,6 +70,8 @@ def summarize(records: list[dict]) -> dict[str, Any]:
              if k.startswith("span/") and isinstance(v, dict)}
     hists = {k[len("hist/"):]: v for k, v in latest.items()
              if k.startswith("hist/") and isinstance(v, dict)}
+    gauges = {k[len("gauge/"):]: v for k, v in latest.items()
+              if k.startswith("gauge/")}
     hbm = {k[len("hbm/"):]: v for k, v in latest.items()
            if k.startswith("hbm/")}
     header_keys = ("run_name", "version", "sample_chunk",
@@ -88,6 +90,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         },
         "spans": spans,
         "hists": hists,
+        "gauges": gauges,
         "hbm": hbm,
         "stalls": stalls,
     }
@@ -130,6 +133,44 @@ def _fmt_hist(name: str, h: dict) -> list[str]:
     return out
 
 
+def _fmt_ingest(summary: dict[str, Any]) -> list[str]:
+    """Ingest-pipeline health from the staging gauges (runtime/ingest.py
+    zero-copy stager; PERF.md 'Ingest pipeline'). Gauges are last-write
+    point samples, so read them as 'state at the final publish'."""
+    gauges = summary.get("gauges", {})
+    occ = gauges.get("ingest_staging_occupancy")
+    width = gauges.get("ingest_coalesce_width")
+    if occ is None and width is None:
+        return []
+    lines = ["ingest staging (zero-copy pipeline gauges):"]
+    if occ is not None:
+        lines.append(f"  staging occupancy      {float(occ):.1%} of the "
+                     f"active buffer (point sample)")
+    if width is not None:
+        lines.append(f"  last coalesce width    {_n(width)} blocks/add "
+                     f"dispatch (1 = idle-drain, >1 = full-buffer "
+                     f"add_many)")
+    # ingest-bound flags: a persistently full staging buffer means
+    # device adds can't keep up with actor arrivals; a replay.add span
+    # eating a large share of host wall-clock means adds steal the
+    # learner's dispatch window
+    if occ is not None and float(occ) >= 0.5:
+        lines.append("    ⚠ staging buffer ≥50% full at last publish: "
+                     "ingest-bound — device adds lag actor arrivals "
+                     "(raise replay.ingest_coalesce or check the h2d "
+                     "link)")
+    spans = summary.get("spans", {})
+    add = spans.get("replay.add")
+    if add:
+        grand = sum(s.get("total_s", 0.0) for s in spans.values()) or 1.0
+        share = float(add.get("total_s", 0.0)) / grand
+        if share >= 0.25:
+            lines.append(f"    ⚠ replay.add is {share:.0%} of host "
+                         f"wall-clock: adds contend with the train "
+                         f"dispatch loop — ingest-bound")
+    return lines
+
+
 def _n(v) -> str:
     if v is None:
         return "-"
@@ -159,6 +200,10 @@ def format_report(summary: dict[str, Any]) -> str:
         lines.append("staleness / distribution percentiles:")
         for name in sorted(summary["hists"]):
             lines.extend(_fmt_hist(name, summary["hists"][name]))
+    ingest_lines = _fmt_ingest(summary)
+    if ingest_lines:
+        lines.append("")
+        lines.extend(ingest_lines)
     if summary["hbm"]:
         lines.append("")
         lines.append("compiled memory (XLA memory_analysis, bytes):")

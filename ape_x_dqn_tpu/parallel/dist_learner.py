@@ -76,6 +76,9 @@ class _DistLearnerBase:
         self.b_local = lcfg.batch_size // self.dp
         self.optimizer = optimizer or make_optimizer(lcfg)
         self._dp_sharding = NamedSharding(mesh, P("dp"))
+        # coalesced ingest groups [g, dp, ...]: replicate the group
+        # axis, shard the dp axis (add_many)
+        self._group_sharding = NamedSharding(mesh, P(None, "dp"))
         self._repl_sharding = NamedSharding(mesh, P())
         self._reshard = None  # publish_params' cached jit (built once)
 
@@ -390,6 +393,31 @@ class _DistLearnerBase:
                 jnp.asarray(x), self._dp_sharding), items)
         return state._replace(
             replay=self.replay.add_lockstep(state.replay, items, td_abs))
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add_many(self, state: DistTrainState, items: Any,
+                 td_abs: jax.Array) -> DistTrainState:
+        """Coalesced ingest: items [g, dp, B, ...], td_abs [g, dp, B] —
+        g staged blocks fused into ONE donated dispatch, so the driver
+        takes _state_lock once per group instead of once per block and
+        a burst of ingest stops interleaving small add dispatches with
+        train_many (runtime/ingest.py).
+
+        UNROLLED Python loop over the static g axis, not lax.scan: a
+        scan carrying the replay storage re-materializes the full
+        storage per iteration on the CPU backend (PERF.md "CPU scan
+        pathology"), while the unrolled chain keeps each add_lockstep's
+        in-place multi-axis DUS aliasing on every backend. g is small
+        (ingest_coalesce), so trace/compile cost is negligible.
+        """
+        items = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                jnp.asarray(x), self._group_sharding), items)
+        rs = state.replay
+        for j in range(td_abs.shape[0]):
+            rs = self.replay.add_lockstep(
+                rs, jax.tree.map(lambda x, j=j: x[j], items), td_abs[j])
+        return state._replace(replay=rs)
 
     # -- weight publication (learner -> inference server over ICI) --------
 

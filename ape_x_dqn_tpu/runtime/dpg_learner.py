@@ -161,6 +161,18 @@ class DPGLearner:
         return state._replace(
             replay=self.replay.add(state.replay, items, td_abs))
 
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add_many(self, state: DPGTrainState, items: Any,
+                 td_abs: jax.Array) -> DPGTrainState:
+        """Coalesced ingest: g staged blocks in one donated dispatch —
+        unrolled over the static g axis (NOT lax.scan; see
+        SingleChipLearner.add_many for the CPU scan pathology)."""
+        rs = state.replay
+        for j in range(td_abs.shape[0]):
+            rs = self.replay.add(
+                rs, jax.tree.map(lambda x, j=j: x[j], items), td_abs[j])
+        return state._replace(replay=rs)
+
     def publish_params(self, state: DPGTrainState) -> dict:
         """Donation-safe {actor, critic} param copies for the inference
         server (the server evaluates mu(s) and Q(s, mu(s)) per query)."""

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.configs import RunConfig
+from ape_x_dqn_tpu.comm.socket_transport import batch_rows
 from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
@@ -42,6 +43,7 @@ from ape_x_dqn_tpu.runtime.family import (
 from ape_x_dqn_tpu.runtime.dpg_learner import DPGLearner
 from ape_x_dqn_tpu.runtime.evaluation import (
     EvalWorker, make_eval_policy_factory)
+from ape_x_dqn_tpu.runtime.ingest import IngestStager
 from ape_x_dqn_tpu.runtime.learner import DQNLearner
 from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 from ape_x_dqn_tpu.runtime.single_process import build_replay
@@ -208,6 +210,22 @@ class ApexDriver:
         self._unit_items = setup.unit_items
         self._stage_dropped = 0
         self._item_spec = item_spec
+        # zero-copy pipelined staging (runtime/ingest.py): wire batches
+        # decode directly into preallocated [coalesce*block] buffers,
+        # double-buffered against the async host->device transfer, and
+        # full buffers ship as ONE coalesced add_many dispatch.
+        # ingest_zero_copy=False restores the legacy list-append +
+        # concatenate-per-flush staging (compat escape hatch).
+        self._stager: IngestStager | None = None
+        if getattr(cfg.replay, "ingest_zero_copy", True):
+            ptail = (cfg.replay.seg_transitions,) if self._frame_mode \
+                else ()
+            self._stager = IngestStager(
+                item_spec, ptail,
+                block_units=self.dp * self._stage_chunk,
+                coalesce=getattr(cfg.replay, "ingest_coalesce", 4),
+                buffers=getattr(cfg.replay, "stage_buffers", 2),
+                ship=self._ship_staged)
         # profiler capture state: False = armed, True = tracing,
         # None = finished/disabled (single capture per run)
         self._profiling: bool | None = False if cfg.profile_dir else None
@@ -391,8 +409,14 @@ class ApexDriver:
                 self.obs.beat("ingest")
                 batch = self.transport.recv_experience(timeout=0.1)
                 if batch is None:
+                    # queue ran dry: ship any complete staged blocks so
+                    # coalescing costs bounded latency (<= the 0.1s poll)
+                    # instead of holding a partial group hostage behind
+                    # a slow actor stream
+                    if self._stager is not None:
+                        self._stager.drain()
                     continue
-                n = int(batch["priorities"].shape[0])
+                n = batch_rows(batch)
                 self._ingest_one(batch, n)
             # ship any staged full blocks; the partial tail is dropped
             # and counted (single-chip and mesh alike — _flush_stage)
@@ -404,13 +428,65 @@ class ApexDriver:
         # sequence batches carry fewer items than env frames; actors ship
         # the true frame count alongside (flat batches: frames == items)
         frames = int(batch.get("frames", n))
-        self._stage.append(batch)
-        self._stage_n += n
-        self._flush_stage()
+        if self._stager is not None:
+            self._stager.put(batch)
+            # below min_fill the learner is stalled waiting on replay:
+            # ship complete blocks eagerly (warmed g=1 graph) instead of
+            # letting coalescing delay the first train dispatch by up to
+            # a full buffer — steady-state keeps the coalesced cadence
+            if self._replay_filled < self._min_fill():
+                self._stager.drain()
+            self.obs.gauge("ingest_staging_occupancy",
+                           self._stager.occupancy())
+        else:
+            self._stage.append(batch)
+            self._stage_n += n
+            self._flush_stage()
         self.frames.add(frames)
         with self._lock:
             self._frames_total += frames
             self._ingested_batches += 1
+
+    def _ship_staged(self, views: dict, g: int) -> list:
+        """Ship g coalesced staged blocks (IngestStager callback): async
+        device_put straight out of the contiguous staging memory, then
+        ONE donated add dispatch under _state_lock. Returns the device
+        handles so the stager can overlap the NEXT buffer's decode with
+        this transfer and only block when about to reuse the memory.
+        g == 1 uses the warmed single-block `add` graph (idle drains);
+        g == coalesce uses the warmed `add_many` — exactly two graphs."""
+        count = g * self.dp * self._stage_chunk
+        if self.is_dist:
+            shape = (g, self.dp, self._stage_chunk) if g > 1 \
+                else (self.dp, self._stage_chunk)
+            sharding = self.learner._group_sharding if g > 1 \
+                else self.learner._dp_sharding
+
+            def put(v):
+                return jax.device_put(v.reshape(shape + v.shape[1:]),
+                                      sharding)
+        else:
+            shape = (g, self._stage_chunk) if g > 1 \
+                else (self._stage_chunk,)
+
+            def put(v):
+                return jax.device_put(v.reshape(shape + v.shape[1:]))
+        staged = {k: put(v) for k, v in views.items()}
+        pris = staged.pop("priorities")
+        handles = list(staged.values()) + [pris]
+        with self._state_lock:
+            with self.obs.span("replay.add", units=count):
+                if g > 1:
+                    self.state = self.learner.add_many(self.state, staged,
+                                                       pris)
+                else:
+                    self.state = self.learner.add(self.state, staged, pris)
+        with self._lock:
+            self._replay_filled = min(
+                self._replay_filled + count * self._unit_items,
+                self.capacity)
+        self.obs.gauge("ingest_coalesce_width", g)
+        return handles
 
     def _add_block(self, take: dict, count: int) -> None:
         """count is in staging units; priorities reshape like items (they
@@ -439,6 +515,25 @@ class ApexDriver:
         shards, keeping priority masses balanced for the dist IS-weight
         approximation), [chunk] single-chip. Fixed shapes keep the add
         jit at exactly one compiled graph."""
+        if self._stager is not None:
+            # zero-copy path: complete blocks ship through the stager;
+            # at force-flush the sub-block tail is DROPPED and counted
+            # in the SAME three denominations as the legacy path below
+            # (the accounting is pinned by tests/test_ingest.py)
+            self._stager.drain()
+            tail = self._stager.tail_units()
+            if force and tail:
+                if self._frame_mode:
+                    self._stage_dropped += int(
+                        (self._stager.tail_view("next_off") > 0).sum())
+                elif self.family == "r2d2":
+                    self._stage_dropped += tail * self.cfg.replay.seq_length
+                else:
+                    self._stage_dropped += tail
+                    with self._lock:
+                        self._frames_total -= tail
+                self._stager.discard_tail()
+            return
         block = self.dp * self._stage_chunk
         while self._stage_n >= block:
             fields = {
@@ -519,6 +614,16 @@ class ApexDriver:
         c_step = cls.train_step.lower(learner, self.state).compile()
         self.obs.log_compiled("add", c_add)
         self.obs.log_compiled("train_step", c_step)
+        if self._stager is not None and self._stager.coalesce > 1:
+            # coalesced ingest groups [g, ...block shape] — the other
+            # add graph the zero-copy stager dispatches (full buffers)
+            g = self._stager.coalesce
+            gexample = jax.tree.map(
+                lambda t: jnp.zeros((g,) + t.shape, t.dtype), example)
+            gpris = jnp.zeros((g,) + pris.shape, jnp.float32)
+            c_addm = cls.add_many.lower(learner, self.state, gexample,
+                                        gpris).compile()
+            self.obs.log_compiled("add_many", c_addm)
         if chunk > 1:
             c_many = cls.train_many.lower(learner, self.state,
                                           chunk).compile()

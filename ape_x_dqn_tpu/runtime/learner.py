@@ -318,6 +318,28 @@ class SingleChipLearner:
         return state._replace(
             replay=self.replay.add(state.replay, items, td_abs))
 
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add_many(self, state: TrainState, items: Any,
+                 td_abs: jax.Array) -> TrainState:
+        """Coalesced ingest: items [g, B, ...], td_abs [g, B] — g staged
+        blocks fused into ONE donated dispatch, so the driver takes
+        _state_lock once per group instead of once per block and a burst
+        of ingest stops interleaving small add dispatches with
+        train_many (runtime/ingest.py).
+
+        UNROLLED Python loop over the static g axis, not lax.scan: a
+        scan carrying the replay storage re-materializes the full
+        storage per iteration on the CPU backend (PERF.md "CPU scan
+        pathology"); the unrolled chain keeps each add's in-place DUS
+        ring write aliasing on every backend. g is small
+        (ingest_coalesce), so trace/compile cost is negligible.
+        """
+        rs = state.replay
+        for j in range(td_abs.shape[0]):
+            rs = self.replay.add(
+                rs, jax.tree.map(lambda x, j=j: x[j], items), td_abs[j])
+        return state._replace(replay=rs)
+
     def publish_params(self, state: TrainState) -> Any:
         """Independent param copy for the inference server — the train/add
         jits donate the TrainState, so aliased buffers would be deleted

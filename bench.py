@@ -503,6 +503,193 @@ def bench_prefetch_ab(args) -> dict:
     return out
 
 
+def _ingest_unit_spec(learner, spec, storage: str):
+    """(item_spec, priority tail) for ONE staging unit — a frame
+    segment (frame_ring) or a transition (flat) — mirroring the
+    driver's staging geometry (runtime/family.py)."""
+    if storage == "frame_ring":
+        replay = learner.replay
+        b, f = replay.B, replay.F
+        item_spec = {
+            "seg_frames": jax.ShapeDtypeStruct((f, *spec.obs_shape[:2]),
+                                               np.uint8),
+            "action": jax.ShapeDtypeStruct((b,), np.int32),
+            "reward": jax.ShapeDtypeStruct((b,), np.float32),
+            "discount": jax.ShapeDtypeStruct((b,), np.float32),
+            "next_off": jax.ShapeDtypeStruct((b,), np.int32),
+        }
+        return item_spec, (b,), b
+    item_spec = {
+        "obs": jax.ShapeDtypeStruct(spec.obs_shape, np.uint8),
+        "action": jax.ShapeDtypeStruct((), np.int32),
+        "reward": jax.ShapeDtypeStruct((), np.float32),
+        "next_obs": jax.ShapeDtypeStruct(spec.obs_shape, np.uint8),
+        "discount": jax.ShapeDtypeStruct((), np.float32),
+    }
+    return item_spec, (), 1
+
+
+def bench_live_soak(args, zero_copy: bool) -> dict:
+    """THE live-vs-offline gap (ISSUE 3): grad-steps/s with a saturating
+    concurrent ingest stream divided by grad-steps/s offline, on the
+    same learner. The ingest thread replays one recorded wire payload
+    through the driver's actual staging mechanics — the zero-copy
+    pipelined stager (runtime/ingest.py: decode_into + double-buffered
+    device_put + coalesced add_many) or a faithful replica of the
+    legacy list-append + concatenate-per-flush + add-per-block path —
+    sharing the state lock with the train_many dispatch loop exactly
+    like runtime/driver.py does."""
+    import threading
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        WireBatch, decode_batch, encode_batch)
+    from ape_x_dqn_tpu.runtime.ingest import IngestStager
+
+    spd, disp = args.ab_steps_per_dispatch, args.ab_dispatches
+    _, learner, state, spec = build_learner(
+        args.ab_capacity, args.ab_batch_size, args.storage,
+        args.sample_chunk)
+    state, _ = prefill(learner, state, spec,
+                       max(args.ab_capacity // 2, 4096), args.storage,
+                       repeats=1)
+    item_spec, ptail, unit_items = _ingest_unit_spec(learner, spec,
+                                                     args.storage)
+    keys = tuple(item_spec.keys()) + ("priorities",)
+    n_wire = 8 if args.storage == "frame_ring" else 64  # units/message
+    block = 2 * n_wire
+    coalesce = 4
+    rng = np.random.default_rng(3)
+    wire = {}
+    for k, s in item_spec.items():
+        shape = (n_wire,) + tuple(s.shape)
+        if np.issubdtype(np.dtype(s.dtype), np.integer):
+            wire[k] = rng.integers(0, 3, size=shape).astype(s.dtype)
+        else:
+            wire[k] = rng.random(shape).astype(s.dtype)
+    wire["priorities"] = (rng.random((n_wire,) + ptail) + 0.1).astype(
+        np.float32)
+    payload = encode_batch(wire)
+
+    holder = {"state": state}
+    lock = threading.Lock()
+    counts = {"units": 0}
+
+    # warm the two ingest graphs (single-block add, coalesced add_many)
+    # and train_many before any timing starts
+    zb = {k: jnp.zeros((block,) + tuple(s.shape), s.dtype)
+          for k, s in item_spec.items()}
+    zp = jnp.zeros((block,) + ptail, jnp.float32)
+    holder["state"] = learner.add(holder["state"], zb, zp)
+    gb = {k: jnp.zeros((coalesce, block) + tuple(s.shape), s.dtype)
+          for k, s in item_spec.items()}
+    gp = jnp.zeros((coalesce, block) + ptail, jnp.float32)
+    holder["state"] = learner.add_many(holder["state"], gb, gp)
+    holder["state"], m = learner.train_many(holder["state"], spd)
+    jax.block_until_ready(m["loss"])
+
+    def ship(views, g):
+        shape = (g, block) if g > 1 else (block,)
+        staged = {k: jax.device_put(v.reshape(shape + v.shape[1:]))
+                  for k, v in views.items()}
+        pris = staged.pop("priorities")
+        handles = list(staged.values()) + [pris]
+        with lock:
+            if g > 1:
+                holder["state"] = learner.add_many(holder["state"],
+                                                   staged, pris)
+            else:
+                holder["state"] = learner.add(holder["state"], staged,
+                                              pris)
+        counts["units"] += g * block
+        return handles
+
+    stop = threading.Event()
+
+    def ingest_zero_copy():
+        stager = IngestStager(item_spec, ptail, block, coalesce, 2, ship)
+        while not stop.is_set():
+            stager.put(WireBatch(payload))
+
+    def ingest_legacy():
+        # faithful replica of the pre-rewrite driver staging: decode to
+        # fresh dicts, append, concatenate the backlog per flush, carry
+        # the rest, one add dispatch (and lock acquisition) per block
+        stage, stage_n = [], 0
+        while not stop.is_set():
+            stage.append(decode_batch(payload))
+            stage_n += n_wire
+            while stage_n >= block:
+                fields = {
+                    k: np.concatenate([np.asarray(b[k]) for b in stage])
+                    for k in keys}
+                take = {k: v[:block] for k, v in fields.items()}
+                rest = {k: v[block:] for k, v in fields.items()}
+                stage = [rest] if rest["priorities"].shape[0] else []
+                stage_n -= block
+                items = {k: jnp.asarray(v) for k, v in take.items()
+                         if k != "priorities"}
+                pris = jnp.asarray(take["priorities"])
+                with lock:
+                    holder["state"] = learner.add(holder["state"], items,
+                                                  pris)
+                counts["units"] += block
+
+    def timed_run() -> float:
+        t0 = time.monotonic()
+        for _ in range(disp):
+            with lock:
+                holder["state"], mm = learner.train_many(holder["state"],
+                                                         spd)
+            jax.block_until_ready(mm["loss"])
+        return spd * disp / (time.monotonic() - t0)
+
+    offline = [timed_run() for _ in range(args.repeats)]
+    thread = threading.Thread(
+        target=ingest_zero_copy if zero_copy else ingest_legacy,
+        daemon=True)
+    t_live = time.monotonic()
+    thread.start()
+    live = [timed_run() for _ in range(args.repeats)]
+    stop.set()
+    thread.join(timeout=10)
+    dt = time.monotonic() - t_live
+    ingest_rate = counts["units"] * unit_items / dt
+    gap = spread(live)["median"] / spread(offline)["median"]
+    tag = "new" if zero_copy else "old"
+    log(f"live soak [{tag}]: offline {spread(offline)} vs live "
+        f"{spread(live)} grad-steps/s -> live_gap "
+        f"{gap:.3f}; concurrent ingest {ingest_rate:,.0f} items/s")
+    return {"offline": spread(offline), "live": spread(live),
+            "live_gap": float(f"{gap:.4g}"),
+            "ingest_items_per_s": float(f"{ingest_rate:.4g}")}
+
+
+def bench_ingest_ab(args) -> dict:
+    """A/B the staging rewrite: live_gap (live / offline grad-steps/s
+    under a saturating concurrent ingest stream) with the legacy
+    staging vs the zero-copy pipelined stager, in BOTH orders on fresh
+    learners (old->new then new->old) so drift artifacts are visible
+    either way. Adoption bar (ISSUE 3): live_gap ~0.51 -> >= 0.75 in
+    both orders with offline grad-steps/s inside the +/-5% noise band."""
+    out = {"batch_size": args.ab_batch_size, "storage": args.storage,
+           "steps_per_dispatch": args.ab_steps_per_dispatch}
+    for order in ("old_first", "new_first"):
+        first_new = order == "new_first"
+        a = bench_live_soak(args, zero_copy=first_new)
+        b = bench_live_soak(args, zero_copy=not first_new)
+        old, new = (b, a) if first_new else (a, b)
+        out[order] = {"old": old, "new": new}
+        log(f"ingest A/B [{order}]: live_gap old {old['live_gap']} -> "
+            f"new {new['live_gap']}; offline old "
+            f"{old['offline']['median']} vs new "
+            f"{new['offline']['median']} grad-steps/s")
+    out["live_gap_old"] = [out[o]["old"]["live_gap"]
+                           for o in ("old_first", "new_first")]
+    out["live_gap_new"] = [out[o]["new"]["live_gap"]
+                           for o in ("old_first", "new_first")]
+    return out
+
+
 def bench_h2d(mb: int = 64, repeats: int = 3, iters: int = 4) -> list[float]:
     """Raw host->device link bandwidth: pure `device_put` MB/s of a
     pinned 64MB buffer, no compute. Round-4 verdict weak #1: the ingest
@@ -585,6 +772,17 @@ def main() -> None:
                    "A/B'). Runs at the --ab-* shapes, INSTEAD of the "
                    "main flagship bench (the stdout metric is then "
                    "the flat off-arm median)")
+    p.add_argument("--ingest-ab", action="store_true",
+                   help="run the zero-copy ingest staging A/B (legacy "
+                   "list-append + concatenate staging vs the pipelined "
+                   "stager, both orders, median-of-`--repeats` per "
+                   "arm): live_gap = grad-steps/s under a saturating "
+                   "concurrent ingest stream / offline grad-steps/s, "
+                   "recorded under secondary.ingest_ab (PERF.md "
+                   "'Ingest pipeline'). Runs at the --ab-* shapes for "
+                   "--storage, INSTEAD of the main flagship bench "
+                   "(the stdout metric is then the old-arm offline "
+                   "median)")
     p.add_argument("--ab-batch-size", type=int, default=64,
                    help="batch size for the prefetch A/B arms (small "
                    "enough to iterate on a CPU host; raise on a real "
@@ -607,6 +805,18 @@ def main() -> None:
             "unit": "steps/s",
             "vs_baseline": round(gsps / 19.0, 2),
             "secondary": {"prefetch_ab": ab},
+        }), flush=True)
+        return
+    if args.ingest_ab:
+        ab = bench_ingest_ab(args)
+        gsps = ab["old_first"]["old"]["offline"]["median"]
+        print(json.dumps({
+            "metric": "learner_grad_steps_per_s",
+            "value": round(gsps, 2),
+            "unit": "steps/s",
+            "vs_baseline": round(gsps / 19.0, 2),
+            "secondary": {"ingest_ab": ab,
+                          "live_gap": ab["live_gap_new"]},
         }), flush=True)
         return
     h2d_rates = bench_h2d(repeats=args.repeats)
@@ -653,6 +863,9 @@ def main() -> None:
     inf_rates = bench_inference(net, spec, repeats=args.repeats)
     log(f"inference: {spread(inf_rates)} forwards/s @ bucket 64")
     secondary["inference_forwards_per_s"] = spread(inf_rates)
+    soak = bench_live_soak(args, zero_copy=True)
+    secondary["live_gap"] = soak["live_gap"]
+    secondary["live_soak"] = soak
     if args.actor_frames > 0:
         ab = bench_actor_pipeline(args.actor_count, args.envs_per_actor,
                                   args.actor_frames)

@@ -5,7 +5,14 @@ exercised on XLA's host-platform device emulation (SURVEY.md §4
 "distributed-without-a-cluster"). Env vars must be set before jax imports.
 """
 
+import faulthandler
 import os
+
+# the full one-command suite has a known native-side SIGSEGV near the
+# end of collection-order runs (ROADMAP.md "Tier-1 invocation"); dump
+# Python tracebacks on fatal signals so the crashing test is
+# attributable instead of a bare exit code 139
+faulthandler.enable()
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")

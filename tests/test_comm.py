@@ -62,6 +62,101 @@ def test_batch_codec_roundtrip():
         assert out[k].dtype == batch[k].dtype
 
 
+def test_unpack_records_mv_zero_copy():
+    """The memoryview unpack path returns views ALIASING the frame (no
+    copies) with contents identical to the copying path."""
+    chunks = [b"", b"x", os.urandom(1000), b"tail"]
+    frame = native.pack_records(chunks)
+    mvs = native.unpack_records_mv(frame)
+    assert [bytes(m) for m in mvs] == chunks
+    for m in mvs:
+        assert isinstance(m, memoryview)
+        assert m.obj is frame  # view into the frame itself, not a copy
+    # bytearray frames (what _recv_exact returns) work identically
+    mvs2 = native.unpack_records_mv(bytearray(frame))
+    assert [bytes(m) for m in mvs2] == chunks
+    with pytest.raises(ValueError):
+        native.unpack_records_mv(frame[:-1])
+
+
+def test_decode_batch_into_matches_decode_batch():
+    """Decode-into-staging lands bitwise what decode_batch returns, for
+    the whole batch and for arbitrary [start, start+limit) windows at
+    arbitrary staging offsets."""
+    from ape_x_dqn_tpu.comm.socket_transport import decode_batch_into
+    batch = {
+        "obs": np.random.randint(0, 255, (7, 8, 8, 2), dtype=np.uint8),
+        "action": np.arange(7, dtype=np.int32),
+        "priorities": np.random.rand(7).astype(np.float32),
+        "actor": 3, "frames": 42,
+    }
+    payload = encode_batch(batch)
+    ref = decode_batch(payload)
+
+    def fresh(cap):
+        return {k: np.zeros((cap,) + v.shape[1:], v.dtype)
+                for k, v in ref.items() if isinstance(v, np.ndarray)}
+
+    dest = fresh(7)
+    k, rows, scalars = decode_batch_into(payload, dest, 0)
+    assert (k, rows) == (7, 7)
+    assert scalars == {"actor": 3, "frames": 42}
+    for key, v in dest.items():
+        np.testing.assert_array_equal(v, ref[key], err_msg=key)
+    # partial window [2, 5) landing at offset 4
+    dest = fresh(16)
+    k, rows, _ = decode_batch_into(payload, dest, 4, start=2, limit=3)
+    assert (k, rows) == (3, 7)
+    for key, v in dest.items():
+        np.testing.assert_array_equal(v[4:7], ref[key][2:5], err_msg=key)
+        assert not v[:4].any() and not v[7:].any(), key
+    # limit past the end clamps
+    dest = fresh(16)
+    k, _, _ = decode_batch_into(payload, dest, 0, start=5, limit=99)
+    assert k == 2
+
+
+def test_wire_batch_dict_protocol():
+    """WireBatch serves every consumer that treated the queue payload as
+    a decoded dict: item access, .get defaults, scalars, row count."""
+    from ape_x_dqn_tpu.comm.socket_transport import WireBatch, batch_rows
+    batch = {
+        "obs": np.random.rand(5, 3).astype(np.float32),
+        "priorities": np.random.rand(5).astype(np.float32),
+        "actor": 1, "frames": 9,
+    }
+    wb = WireBatch(encode_batch(batch))
+    assert wb.rows == 5 and batch_rows(wb) == 5
+    assert batch_rows(batch) == 5  # dict form reads priorities
+    assert wb.get("frames", 5) == 9 and wb.get("missing") is None
+    assert wb["actor"] == 1
+    np.testing.assert_array_equal(wb["obs"], batch["obs"])
+    assert wb["obs"] is wb["obs"]  # materialized arrays are cached
+    assert "priorities" in wb and "nope" not in wb
+    assert set(wb.keys()) == set(batch.keys())
+    with pytest.raises(KeyError):
+        wb["nope"]
+
+
+def test_server_get_params_caches_deserialized_tree():
+    """The learner-host local param pull must not pay a pickle
+    round-trip per call: the deserialized tree is cached per version
+    and invalidated on the next publish."""
+    server = SocketIngestServer("127.0.0.1", 0)
+    try:
+        server.publish_params({"w": np.ones(3, np.float32)}, 5)
+        p1, v1 = server.get_params()
+        p2, v2 = server.get_params()
+        assert v1 == v2 == 5
+        assert p1["w"] is p2["w"]  # cached, not re-deserialized
+        server.publish_params({"w": np.full(3, 2.0, np.float32)}, 6)
+        p3, v3 = server.get_params()
+        assert v3 == 6
+        np.testing.assert_array_equal(p3["w"], np.full(3, 2.0))
+    finally:
+        server.stop()
+
+
 # -- socket transport --------------------------------------------------------
 
 

@@ -1,0 +1,289 @@
+"""Zero-copy ingest staging (runtime/ingest.py + driver integration):
+
+- partial-tail drop accounting at _flush_stage(force=True) in all three
+  denominations (flat units, frame-ring live transitions, r2d2 sequence
+  upper bound), on BOTH staging paths (legacy list-append and zero-copy
+  stager) — the accounting must survive the staging rewrite exactly
+- bitwise ingest parity: the same recorded wire stream lands identical
+  replay-bound blocks through decode-into-staging as through the legacy
+  decode_batch + concatenate path, for flat + frame-ring + r2d2
+- IngestStager unit behavior: boundary splitting, coalesced ships,
+  drain compaction, tail exposure
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.comm.socket_transport import WireBatch, encode_batch
+from ape_x_dqn_tpu.configs import (
+    ActorConfig, EnvConfig, InferenceConfig, LearnerConfig, NetworkConfig,
+    ParallelConfig, ReplayConfig, RunConfig, get_config)
+from ape_x_dqn_tpu.runtime.driver import ApexDriver
+from ape_x_dqn_tpu.runtime.ingest import IngestStager
+
+
+def _flat_cfg(**replay_kw):
+    return get_config("cartpole_smoke").replace(
+        replay=ReplayConfig(kind="prioritized", capacity=2048, min_fill=64,
+                            **replay_kw),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20),
+        actors=ActorConfig(num_actors=1, base_eps=0.5, ingest_batch=16),
+        inference=InferenceConfig(max_batch=4, deadline_ms=0.5),
+        eval_every_steps=0, eval_episodes=0,
+    )
+
+
+def _ring_cfg(**replay_kw):
+    return RunConfig(
+        name="catch",
+        env=EnvConfig(id="catch", kind="synthetic_atari", frame_skip=4,
+                      max_noop_start=4),
+        network=NetworkConfig(kind="nature_cnn", dueling=True),
+        replay=ReplayConfig(kind="prioritized", capacity=4096, min_fill=128,
+                            storage="frame_ring", seg_transitions=8,
+                            segs_per_add=2, **replay_kw),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20),
+        actors=ActorConfig(num_actors=1, base_eps=0.5, ingest_batch=8),
+        inference=InferenceConfig(max_batch=4, deadline_ms=0.5),
+        eval_every_steps=0, eval_episodes=0,
+    )
+
+
+def _r2d2_cfg(**replay_kw):
+    return get_config("r2d2").replace(
+        env=EnvConfig(id="CartPolePO", kind="cartpole_po"),
+        network=NetworkConfig(kind="lstm_q", lstm_size=32, torso_dense=64,
+                              dueling=True, compute_dtype="float32"),
+        replay=ReplayConfig(kind="sequence", capacity=512, seq_length=16,
+                            seq_overlap=8, burn_in=4, min_fill=32,
+                            priority_eta=0.9, **replay_kw),
+        learner=LearnerConfig(batch_size=16, n_step=3, value_rescale=True,
+                              target_sync_every=100, lr=1e-3,
+                              publish_every=25, train_chunk=4),
+        actors=ActorConfig(num_actors=1, base_eps=0.4, ingest_batch=64),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        parallel=ParallelConfig(dp=1, tp=1),
+        eval_every_steps=0, eval_episodes=0,
+    )
+
+
+def _synth_batch(driver, n, seed=0, frames=None):
+    """Item-spec-conforming random batch of n staging units."""
+    rng = np.random.default_rng(seed)
+    batch = {}
+    for k, s in driver._item_spec.items():
+        shape = (n,) + tuple(s.shape)
+        if np.issubdtype(np.dtype(s.dtype), np.integer):
+            batch[k] = rng.integers(0, 3, size=shape).astype(s.dtype)
+        else:
+            batch[k] = (rng.random(shape) * 4).astype(s.dtype)
+    ptail = (driver.cfg.replay.seg_transitions,) if driver._frame_mode \
+        else ()
+    batch["priorities"] = rng.random((n,) + ptail).astype(np.float32)
+    if frames is not None:
+        batch["frames"] = frames
+    return batch
+
+
+# -- drop accounting (pins the legacy semantics; the stager must match) ----
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_flat_tail_drop_accounting(zero_copy):
+    """Flat denomination: 1 unit = 1 env frame; the dropped tail comes
+    OFF _frames_total so frames reconcile with replay contents."""
+    d = ApexDriver(_flat_cfg(ingest_zero_copy=zero_copy))
+    assert (d._stager is not None) == zero_copy
+    block = d.dp * d._stage_chunk
+    tail = 3
+    d._ingest_one(_synth_batch(d, block + tail), block + tail)
+    d._flush_stage(force=True)
+    assert d._stage_dropped == tail
+    assert d._frames_total == block  # ingested minus dropped tail
+    assert d._replay_filled == block * d._unit_items
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_frame_ring_tail_drop_accounting(zero_copy):
+    """Frame-ring denomination: dropped segments count their LIVE
+    transitions (next_off > 0); _frames_total stays (env frames ride
+    ingest messages separately in frame mode)."""
+    d = ApexDriver(_ring_cfg(ingest_zero_copy=zero_copy))
+    block = d.dp * d._stage_chunk
+    tail = 1
+    batch = _synth_batch(d, block + tail, frames=37)
+    # make the tail segment's liveness pattern explicit
+    batch["next_off"][block:] = 0
+    batch["next_off"][block:, :5] = 2  # 5 live transitions in the tail
+    d._ingest_one(batch, block + tail)
+    d._flush_stage(force=True)
+    assert d._stage_dropped == 5
+    assert d._frames_total == 37  # untouched by the drop
+    assert d._replay_filled == block * d._unit_items
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_r2d2_tail_drop_accounting(zero_copy):
+    """R2D2 denomination: units are sequences; drops count seq_length
+    transitions per sequence (upper bound); _frames_total stays."""
+    d = ApexDriver(_r2d2_cfg(ingest_zero_copy=zero_copy))
+    block = d.dp * d._stage_chunk
+    tail = 2
+    d._ingest_one(_synth_batch(d, block + tail, frames=29), block + tail)
+    d._flush_stage(force=True)
+    assert d._stage_dropped == tail * d.cfg.replay.seq_length
+    assert d._frames_total == 29
+    assert d._replay_filled == block * d._unit_items
+
+
+def test_drop_accounting_in_run_report():
+    """_stage_dropped reaches the run report's ingest_dropped."""
+    d = ApexDriver(_flat_cfg(ingest_zero_copy=True))
+    block = d.dp * d._stage_chunk
+    d._ingest_one(_synth_batch(d, block + 2), block + 2)
+    d._flush_stage(force=True)
+    assert d._stage_dropped == 2
+
+
+# -- bitwise ingest parity: zero-copy vs legacy on a recorded stream -------
+
+
+def _record_stream(cfg_fn, sizes, payloads):
+    """Feed the same recorded wire payloads through one driver built
+    from cfg_fn, with device shipping stubbed to capture host blocks;
+    returns (per-key concatenated rows, dropped, frames_total)."""
+    cfg = cfg_fn()
+    d = ApexDriver(cfg)
+    recorded = []
+    if d._stager is not None:
+        def ship(views, g):
+            recorded.append({k: np.array(v) for k, v in views.items()})
+            return []
+        d._stager._ship = ship
+    else:
+        def add_block(take, count):
+            recorded.append({k: np.array(v) for k, v in take.items()})
+        d._add_block = add_block
+    from ape_x_dqn_tpu.comm.socket_transport import decode_batch
+    for n, payload in zip(sizes, payloads):
+        batch = WireBatch(payload) if d._stager is not None \
+            else decode_batch(payload)
+        d._ingest_one(batch, n)
+    d._flush_stage(force=True)
+    keys = d._item_keys + ("priorities",)
+    rows = {k: (np.concatenate([r[k] for r in recorded])
+                if recorded else None) for k in keys}
+    return rows, d._stage_dropped, d._frames_total
+
+
+@pytest.mark.parametrize("cfg_fn", [_flat_cfg, _ring_cfg, _r2d2_cfg],
+                         ids=["flat", "frame_ring", "r2d2"])
+def test_ingest_parity_zero_copy_vs_legacy(cfg_fn):
+    """The SAME recorded wire stream (ragged batch sizes, so staging
+    boundaries are crossed mid-batch) must land bitwise-identical
+    replay-bound blocks through both staging paths, with identical
+    drop accounting."""
+    probe = ApexDriver(cfg_fn())
+    sizes = [3, 7, 1, 6, 5, 2]
+    payloads = []
+    for i, n in enumerate(sizes):
+        b = _synth_batch(probe, n, seed=100 + i, frames=n)
+        payloads.append(encode_batch(b))
+    del probe
+    new = _record_stream(lambda: cfg_fn(), sizes, payloads)
+    old = _record_stream(
+        lambda: cfg_fn().replace(
+            replay=dataclasses.replace(cfg_fn().replay,
+                                       ingest_zero_copy=False)),
+        sizes, payloads)
+    assert new[1] == old[1]  # dropped
+    assert new[2] == old[2]  # frames_total
+    for k in new[0]:
+        a, b = new[0][k], old[0][k]
+        assert (a is None) == (b is None), k
+        if a is not None:
+            assert a.dtype == b.dtype, k
+            np.testing.assert_array_equal(a, b, err_msg=k)
+
+
+# -- IngestStager unit behavior --------------------------------------------
+
+
+def _unit_stager(block=4, coalesce=2, buffers=2):
+    spec = {"x": jax.ShapeDtypeStruct((2,), np.float32),
+            "y": jax.ShapeDtypeStruct((), np.int32)}
+    shipped = []
+
+    def ship(views, g):
+        shipped.append((g, {k: np.array(v) for k, v in views.items()}))
+        return []
+
+    return IngestStager(spec, (), block, coalesce, buffers, ship), shipped
+
+
+def _rows(n, base):
+    return {"x": np.arange(n * 2, dtype=np.float32).reshape(n, 2) + base,
+            "y": np.arange(n, dtype=np.int32) + base,
+            "priorities": np.arange(n, dtype=np.float32) + base}
+
+
+def test_stager_coalesced_ship_and_boundary_split():
+    st, shipped = _unit_stager(block=4, coalesce=2)
+    st.put(_rows(3, 0))          # cursor 3
+    st.put(_rows(7, 100))        # fills 8 (ship g=2) + 2 into next buffer
+    assert len(shipped) == 1
+    g, views = shipped[0]
+    assert g == 2 and views["x"].shape == (8, 2)
+    # the 8 shipped rows are the stream's first 8, in order
+    expect = np.concatenate([_rows(3, 0)["x"], _rows(7, 100)["x"][:5]])
+    np.testing.assert_array_equal(views["x"], expect)
+    assert st.tail_units() == 2
+    assert st.occupancy() == pytest.approx(2 / 8)
+
+
+def test_stager_drain_ships_blocks_and_compacts():
+    st, shipped = _unit_stager(block=4, coalesce=2)
+    st.put(_rows(6, 0))          # cursor 6: one full block + 2 rem
+    assert st.drain() == 1
+    assert len(shipped) == 1 and shipped[0][0] == 1
+    np.testing.assert_array_equal(shipped[0][1]["x"], _rows(6, 0)["x"][:4])
+    # remainder compacted to the buffer front
+    assert st.tail_units() == 2
+    np.testing.assert_array_equal(st.tail_view("x"), _rows(6, 0)["x"][4:])
+    # draining again with no complete block is a no-op
+    assert st.drain() == 0
+    # the compacted rows still flow into the next coalesced group
+    st.put(_rows(6, 50))
+    assert len(shipped) == 2 and shipped[1][0] == 2
+    expect = np.concatenate([_rows(6, 0)["x"][4:], _rows(6, 50)["x"]])
+    np.testing.assert_array_equal(shipped[1][1]["x"], expect)
+    assert st.tail_units() == 0
+
+
+def test_stager_wire_batch_decode_into():
+    """WireBatch payloads land via decode_into (the zero-copy path) and
+    match what the dict path stages bitwise."""
+    st_wire, shipped_wire = _unit_stager(block=4, coalesce=1)
+    st_dict, shipped_dict = _unit_stager(block=4, coalesce=1)
+    for i, n in enumerate([3, 5, 4]):
+        rows = _rows(n, 10 * i)
+        st_wire.put(WireBatch(encode_batch(rows)))
+        st_dict.put(rows)
+    assert len(shipped_wire) == len(shipped_dict) == 3
+    for (gw, vw), (gd, vd) in zip(shipped_wire, shipped_dict):
+        assert gw == gd
+        for k in vw:
+            np.testing.assert_array_equal(vw[k], vd[k], err_msg=k)
+
+
+def test_stager_discard_tail():
+    st, shipped = _unit_stager(block=4, coalesce=2)
+    st.put(_rows(3, 0))
+    assert st.tail_units() == 3
+    st.discard_tail()
+    assert st.tail_units() == 0 and shipped == []
