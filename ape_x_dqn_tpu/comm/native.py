@@ -27,6 +27,7 @@ _SO = os.path.join(os.path.dirname(_SRC), "libapex_framing.so")
 
 _lib: ctypes.CDLL | None = None
 _tried = False
+_has_delta = False
 
 Buffer = bytes | bytearray | memoryview
 
@@ -34,7 +35,7 @@ Buffer = bytes | bytearray | memoryview
 def _load() -> ctypes.CDLL | None:
     # module-level cache: the codec runs per ingest message; don't
     # re-enter build_and_load's lock or rebind argtypes per call
-    global _lib, _tried
+    global _lib, _tried, _has_delta
     if _tried:
         return _lib
     lib = build_and_load(_SRC, _SO)
@@ -57,12 +58,32 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64]
         except AttributeError:
             lib = None  # stale .so missing a symbol: Python fallback
+    if lib is not None:
+        try:
+            # delta symbols bound separately: a stale .so predating the
+            # wire codec still serves crc/pack, and only the delta
+            # transform falls back to numpy (wire-compatible either way)
+            lib.apex_delta_encode.restype = None
+            lib.apex_delta_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_uint64, ctypes.c_uint64]
+            lib.apex_delta_undo.restype = None
+            lib.apex_delta_undo.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64]
+            _has_delta = True
+        except AttributeError:
+            _has_delta = False
     _lib, _tried = lib, True
     return _lib
 
 
 def have_native() -> bool:
     return _load() is not None
+
+
+def have_delta_native() -> bool:
+    _load()
+    return _has_delta
 
 
 def _addr(data: Buffer) -> tuple[ctypes.c_void_p, int, object]:
@@ -178,3 +199,50 @@ def unpack_records_mv(frame: Buffer,
     if mv.ndim != 1 or mv.itemsize != 1:
         mv = mv.cast("B")
     return [mv[o:o + ln] for o, ln in _unpack_offsets(frame, max_records)]
+
+
+# -- XOR-delta transform (wire codec "delta-deflate") -----------------------
+
+
+def delta_encode(rows2d) -> "bytes":
+    """XOR-delta a C-contiguous (rows, row_bytes) uint8 array along its
+    leading axis: out[0] = rows2d[0], out[i] = rows2d[i] ^ rows2d[i-1].
+    Returns the delta bytes (the deflate input on the encode side)."""
+    import numpy as np
+
+    a = np.ascontiguousarray(rows2d, dtype=np.uint8)
+    lib = _load()
+    if lib is None or not _has_delta or a.shape[0] == 0:
+        out = np.empty_like(a)
+        if a.shape[0]:
+            out[0] = a[0]
+            np.bitwise_xor(a[1:], a[:-1], out=out[1:])
+        return out.tobytes()
+    out = np.empty_like(a)
+    dptr, _, dkeep = _addr(memoryview(out).cast("B"))
+    sptr, _, skeep = _addr(memoryview(a).cast("B"))
+    lib.apex_delta_encode(dptr, sptr, a.shape[0], a.shape[1])
+    del dkeep, skeep
+    return out.tobytes()
+
+
+def delta_undo_inplace(rows2d) -> None:
+    """Prefix-XOR undo IN PLACE on a writable C-contiguous
+    (rows, row_bytes) uint8 array: rows2d[i] ^= rows2d[i-1] for
+    i = 1..rows-1. Row 0 must already be absolute — on the ingest path
+    the caller lands delta rows straight in the staging block, fixes
+    row 0 up against the previous landed row, then calls this."""
+    import numpy as np
+
+    a = rows2d
+    if a.shape[0] <= 1:
+        return
+    lib = _load()
+    if lib is None or not _has_delta:
+        # ufunc accumulate is the vectorized-per-row C path in numpy:
+        # absolute[i] = delta[0] ^ delta[1] ^ ... ^ delta[i]
+        np.bitwise_xor.accumulate(a, axis=0, out=a)
+        return
+    ptr, _, keep = _addr(memoryview(a).cast("B"))
+    lib.apex_delta_undo(ptr, a.shape[0], a.shape[1])
+    del keep

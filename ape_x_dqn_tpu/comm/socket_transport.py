@@ -28,6 +28,26 @@ log the skew warning). Mixed-build fleets must either upgrade actor
 hosts first or run the learner with --param-wire-dtype float32, whose
 blobs remain loadable by every build. Same-build fleets (the supported
 deployment) are unaffected.
+
+WIRE CODEC ("delta-deflate", default-on, CommConfig.wire_codec): the
+ingest wire is the measured #1 live bottleneck (PERF.md round-4 re-soak:
+10.5 MB/s sustained, ~9.7KB/transition), so experience leaves are
+compressed per-leaf before framing: uint8 frame rows ship as XOR-delta
+against the previous row in the block (temporally adjacent frames ->
+mostly-zero deltas; native fast path in cpp/framing.cpp) followed by
+stdlib zlib deflate; bool leaves bit-pack (np.packbits) + deflate;
+integer leaves deflate (RLE-grade on action/done streams); float leaves
+stay raw (incompressible). Each leaf's encoding rides the JSON meta
+header ("enc" tag), with a per-leaf raw fallback whenever compression
+would not shrink it — so a codec payload is fully self-describing.
+Codec payloads use a distinct message type (MSG_EXPERIENCE_C) and are
+only sent after a connect-time hello/ack negotiation: a new client
+offers its codec (MSG_HELLO), a new server answers with the agreed
+choice (MSG_HELLO_ACK), an OLD server silently ignores the hello (its
+reader drops unknown types) and the client falls back to raw on the ack
+timeout. Old clients never send a hello and keep sending raw
+MSG_EXPERIENCE, which every server still accepts — old<->new peers
+interoperate in both directions.
 """
 
 from __future__ import annotations
@@ -39,6 +59,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from typing import Any
 
 import numpy as np
@@ -49,34 +70,164 @@ MAGIC = 0x41504558  # 'APEX'
 MSG_EXPERIENCE = 1
 MSG_PARAMS_REQ = 2
 MSG_PARAMS = 3
+MSG_HELLO = 4          # client codec offer (JSON), sent on connect
+MSG_HELLO_ACK = 5      # server's codec choice (JSON)
+MSG_EXPERIENCE_C = 6   # experience payload with codec-encoded leaves
+
+WIRE_CODECS = ("raw", "delta-deflate")
 
 _HDR = struct.Struct("<IBIQ")  # magic, type, crc, payload_len
 MAX_PAYLOAD = 1 << 31
 _WARNED_BAD_BLOB = False
 
+# delta+deflate only pays on frame-sized rows; small rows (actions,
+# rewards) would spend more header than they save
+_DELTA_MIN_ROW_BYTES = 1024
+# Z_BEST_SPEED: the encoder runs on actor-host CPUs next to env
+# stepping; on mostly-zero XOR deltas level 1 already collapses runs,
+# higher levels buy single-digit % ratio for multiples of encode time
+_DEFLATE_LEVEL = 1
+
+
+def _check_codec(codec: str) -> str:
+    if codec not in WIRE_CODECS:
+        raise ValueError(
+            f"wire_codec must be one of {WIRE_CODECS}, got {codec!r}")
+    return codec
+
 
 # -- codec ------------------------------------------------------------------
 
 
-def encode_batch(batch: dict) -> bytes:
+def _encode_leaf(v: np.ndarray) -> tuple[str, bytes] | None:
+    """(enc tag, compressed bytes) for one array leaf under the
+    delta-deflate codec, or None to ship it raw. Per-leaf policy:
+    frame-like uint8 rows -> XOR-delta vs the previous row + deflate
+    ("xd"); bools -> bit-pack + deflate ("bp"); other integers ->
+    deflate ("d"); floats raw. Any leaf whose compressed form would not
+    shrink falls back to raw — the codec can never inflate a message."""
+    if v.dtype == np.uint8 and v.ndim >= 2 and v.shape[0] >= 2 \
+            and v[0].nbytes >= _DELTA_MIN_ROW_BYTES:
+        delta = native.delta_encode(v.reshape(v.shape[0], -1))
+        comp = zlib.compress(delta, _DEFLATE_LEVEL)
+        return ("xd", comp) if len(comp) < v.nbytes else None
+    if v.dtype == np.bool_:
+        comp = zlib.compress(np.packbits(v.reshape(-1)).tobytes(),
+                             _DEFLATE_LEVEL)
+        return ("bp", comp) if len(comp) < v.nbytes else None
+    if np.issubdtype(v.dtype, np.integer):
+        buf = memoryview(v).cast("B") if v.flags["WRITEABLE"] \
+            else v.tobytes()
+        comp = zlib.compress(buf, _DEFLATE_LEVEL)
+        return ("d", comp) if len(comp) < v.nbytes else None
+    return None
+
+
+def encode_batch(batch: dict, codec: str = "raw") -> bytes:
     """Experience dict (numpy arrays + scalars) -> framed payload.
 
     Already-contiguous arrays hand their buffer straight to
     pack_records (which memcpys into the frame) — zero extra copies;
     the old ascontiguousarray + tobytes() path copied every array
-    twice before the frame copy."""
+    twice before the frame copy.
+
+    codec="delta-deflate" compresses leaves per _encode_leaf's policy
+    and tags each compressed leaf in the JSON meta ("enc"), keeping the
+    payload self-describing; callers must only ship such payloads to
+    peers that negotiated the codec (as MSG_EXPERIENCE_C)."""
+    _check_codec(codec)
     meta, arrays = [], []
     for k, v in batch.items():
         if isinstance(v, np.ndarray):
             if not v.flags["C_CONTIGUOUS"]:
                 v = np.ascontiguousarray(v)
-            meta.append({"k": k, "nd": True, "dt": v.dtype.str,
-                         "sh": list(v.shape)})
-            arrays.append(memoryview(v).cast("B") if v.flags["WRITEABLE"]
-                          else v.tobytes())
+            m = {"k": k, "nd": True, "dt": v.dtype.str, "sh": list(v.shape)}
+            encoded = _encode_leaf(v) if codec != "raw" else None
+            if encoded is not None:
+                m["enc"] = encoded[0]
+                arrays.append(encoded[1])
+            else:
+                arrays.append(memoryview(v).cast("B")
+                              if v.flags["WRITEABLE"] else v.tobytes())
+            meta.append(m)
         else:
             meta.append({"k": k, "nd": False, "v": v})
     return native.pack_records([json.dumps(meta).encode()] + arrays)
+
+
+def _leaf_nbytes(m: dict) -> int:
+    """Decoded (raw) byte size of an array leaf, from its meta alone."""
+    return int(np.prod(m["sh"], dtype=np.int64)) * np.dtype(m["dt"]).itemsize
+
+
+def _new_cache() -> dict:
+    """Per-payload decode scratch for codec leaves: inflated deflate
+    streams (reused by every decode_into split of the same payload),
+    per-leaf delta continuation (next expected start row + the last
+    decoded ABSOLUTE row — the XOR anchor when a batch splits across
+    staging buffers), and fully-materialized small leaves."""
+    return {"inflated": {}, "prev": {}, "full": {}}
+
+
+def _inflate_leaf(cache: dict, m: dict, rec) -> bytes:
+    """Inflate one compressed leaf record, cached per payload. The
+    inflate OUTPUT takes over the wire buffer's role on the zero-copy
+    path: landing it in the staging block stays the one copy per
+    (decoded) byte. Truncated/corrupt streams reject with ValueError —
+    the server reader drops such a connection like any misframed one."""
+    key = m["k"]
+    buf = cache["inflated"].get(key)
+    if buf is None:
+        expected = _leaf_nbytes(m) if m["enc"] != "bp" \
+            else (int(np.prod(m["sh"], dtype=np.int64)) + 7) // 8
+        try:
+            buf = zlib.decompress(rec)
+        except zlib.error as e:
+            raise ValueError(f"corrupt codec stream for leaf {key!r}: {e}")
+        if len(buf) != expected:
+            raise ValueError(
+                f"codec stream for leaf {key!r} inflates to {len(buf)} "
+                f"bytes, expected {expected}")
+        cache["inflated"][key] = buf
+    return buf
+
+
+def _decode_leaf_full(m: dict, rec, cache: dict | None = None) -> np.ndarray:
+    """Materialize one array leaf (any encoding) as a fresh array."""
+    dt, sh, enc = np.dtype(m["dt"]), m["sh"], m.get("enc")
+    if enc is None:
+        return np.frombuffer(rec, dtype=dt).reshape(sh).copy()
+    cache = cache if cache is not None else _new_cache()
+    full = cache["full"].get(m["k"])
+    if full is not None:
+        return full
+    buf = _inflate_leaf(cache, m, rec)
+    if enc == "bp":
+        n = int(np.prod(sh, dtype=np.int64))
+        arr = np.unpackbits(np.frombuffer(buf, np.uint8),
+                            count=n).view(np.bool_).reshape(sh)
+    elif enc in ("d", "xd"):
+        arr = np.frombuffer(buf, dtype=dt).reshape(sh).copy()
+        if enc == "xd" and arr.shape[0] > 1:
+            native.delta_undo_inplace(
+                arr.reshape(arr.shape[0], -1).view(np.uint8))
+    else:
+        raise ValueError(f"unknown wire codec leaf encoding {enc!r}")
+    cache["full"][m["k"]] = arr
+    return arr
+
+
+def decode_batch(payload) -> dict:
+    meta, recs = _parse_payload(payload)
+    out: dict = {}
+    i = 1
+    for m in meta:
+        if m["nd"]:
+            out[m["k"]] = _decode_leaf_full(m, recs[i])
+            i += 1
+        else:
+            out[m["k"]] = m["v"]
+    return out
 
 
 def _parse_payload(payload) -> tuple[list, list[memoryview]]:
@@ -87,27 +238,50 @@ def _parse_payload(payload) -> tuple[list, list[memoryview]]:
     return meta, recs
 
 
-def decode_batch(payload) -> dict:
-    meta, recs = _parse_payload(payload)
-    out: dict = {}
-    i = 1
-    for m in meta:
-        if m["nd"]:
-            arr = np.frombuffer(recs[i], dtype=np.dtype(m["dt"]))
-            out[m["k"]] = arr.reshape(m["sh"]).copy()
-            i += 1
-        else:
-            out[m["k"]] = m["v"]
-    return out
+def _land_delta_rows(m: dict, dslice: np.ndarray, buf: bytes, start: int,
+                     k: int, cache: dict) -> None:
+    """Land delta rows [start, start+k) of an "xd" leaf at dslice and
+    undo the XOR IN PLACE in the staging memory: copy the inflated
+    delta rows in (the one landing copy), XOR row 0 against the
+    previous landed ABSOLUTE row when the batch split across staging
+    buffers, then prefix-undo the rest (native fast path, numpy
+    accumulate fallback)."""
+    sh = m["sh"]
+    dt = np.dtype(m["dt"])
+    row = int(np.prod(sh[1:], dtype=np.int64))
+    src = np.frombuffer(buf, dtype=dt, count=k * row,
+                        offset=start * row * dt.itemsize)
+    dslice[...] = src.reshape((k, *sh[1:]))
+    flat = dslice.reshape(k, -1).view(np.uint8)
+    if start > 0:
+        prev = None
+        cont = cache["prev"].get(m["k"])
+        if cont is not None and cont[0] == start:
+            prev = cont[1]
+        if prev is None:
+            # non-sequential access (no continuation): the absolute
+            # row before `start` is the XOR-prefix of all delta rows
+            # up to it — rare path, the stager always advances start
+            # sequentially
+            allrows = np.frombuffer(buf, dtype=np.uint8,
+                                    count=start * row * dt.itemsize)
+            prev = np.bitwise_xor.reduce(
+                allrows.reshape(start, -1), axis=0)
+        np.bitwise_xor(flat[0], prev, out=flat[0])
+    native.delta_undo_inplace(flat)
+    cache["prev"][m["k"]] = (start + k, flat[-1].copy())
 
 
 def _decode_rows_into(meta: list, recs: list[memoryview], dest: dict,
-                      offset: int, start: int, limit: int) -> int:
+                      offset: int, start: int, limit: int,
+                      cache: dict | None = None) -> int:
     """Land rows [start, start+k) of every array record directly in
-    dest[key][offset:offset+k] — ONE copy per wire byte, contiguous by
-    construction. Returns k (rows written). Wire arrays without a
-    matching dest key are skipped (the legacy stage likewise only read
-    the item keys it knew)."""
+    dest[key][offset:offset+k] — ONE copy per (decoded) wire byte,
+    contiguous by construction. Returns k (rows written). Wire arrays
+    without a matching dest key are skipped (the legacy stage likewise
+    only read the item keys it knew). Codec leaves ("enc" meta tag)
+    inflate once per payload (cached) and land with the delta-undo
+    applied in place in the staging rows."""
     written = None
     i = 1
     for m in meta:
@@ -120,11 +294,32 @@ def _decode_rows_into(meta: list, recs: list[memoryview], dest: dict,
         sh = m["sh"]
         total = int(sh[0]) if sh else 0
         k = max(min(limit, total - start), 0)
-        dt = np.dtype(m["dt"])
-        row = int(np.prod(sh[1:], dtype=np.int64))
-        src = np.frombuffer(rec, dtype=dt, count=k * row,
-                            offset=start * row * dt.itemsize)
-        d[offset:offset + k] = src.reshape((k, *sh[1:]))
+        enc = m.get("enc")
+        if enc is None:
+            dt = np.dtype(m["dt"])
+            row = int(np.prod(sh[1:], dtype=np.int64))
+            src = np.frombuffer(rec, dtype=dt, count=k * row,
+                                offset=start * row * dt.itemsize)
+            d[offset:offset + k] = src.reshape((k, *sh[1:]))
+        elif k > 0:
+            if cache is None:
+                cache = _new_cache()
+            if enc == "xd":
+                buf = _inflate_leaf(cache, m, rec)
+                _land_delta_rows(m, d[offset:offset + k], buf, start, k,
+                                 cache)
+            elif enc == "d":
+                buf = _inflate_leaf(cache, m, rec)
+                dt = np.dtype(m["dt"])
+                row = int(np.prod(sh[1:], dtype=np.int64))
+                src = np.frombuffer(buf, dtype=dt, count=k * row,
+                                    offset=start * row * dt.itemsize)
+                d[offset:offset + k] = src.reshape((k, *sh[1:]))
+            else:
+                # bit-packed bools (tiny leaves): materialize once per
+                # payload, then row-slice — not worth a fused landing
+                full = _decode_leaf_full(m, rec, cache)
+                d[offset:offset + k] = full[start:start + k]
         written = k
     return written or 0
 
@@ -139,7 +334,9 @@ def decode_batch_into(payload, dest: dict, offset: int, start: int = 0,
     where k = min(limit, rows-start). Returns (k, rows, scalars) —
     scalars are the non-array entries (e.g. "frames", "actor"). Callers
     split a batch across staging-buffer boundaries by calling again
-    with an advanced `start`."""
+    with an advanced `start` (use WireBatch.decode_into for split
+    decodes of codec payloads — it carries the inflate + delta
+    continuation cache across calls)."""
     meta, recs = _parse_payload(payload)
     rows = batch_rows_meta(meta)
     if limit is None:
@@ -171,15 +368,21 @@ class WireBatch:
     the queue directly) treats it like the dict decode_batch used to
     return — item access materializes arrays on demand and caches them.
     Scalar metadata ("frames", "actor") and the row count come from the
-    JSON header alone, with no array copies."""
+    JSON header alone, with no array copies.
 
-    __slots__ = ("payload", "_meta", "_recs", "_arrays")
+    Codec payloads (MSG_EXPERIENCE_C) decode through the same interface:
+    _cache holds the per-leaf inflate output and the delta-undo
+    continuation so a batch split across staging buffers inflates each
+    leaf ONCE and chains the XOR across decode_into calls."""
+
+    __slots__ = ("payload", "_meta", "_recs", "_arrays", "_cache")
 
     def __init__(self, payload):
         self.payload = payload
         self._meta: list | None = None
         self._recs: list[memoryview] | None = None
         self._arrays: dict = {}
+        self._cache: dict | None = None
 
     def _parsed(self) -> tuple[list, list[memoryview]]:
         if self._meta is None:
@@ -192,6 +395,18 @@ class WireBatch:
         meta, _ = self._parsed()
         return batch_rows_meta(meta)
 
+    @property
+    def wire_nbytes(self) -> int:
+        """Bytes this batch occupied on the wire (payload size)."""
+        return len(self.payload)
+
+    @property
+    def raw_nbytes(self) -> int:
+        """Bytes the array leaves would occupy uncompressed — the
+        numerator of the wire compression ratio (header-only)."""
+        meta, _ = self._parsed()
+        return sum(_leaf_nbytes(m) for m in meta if m["nd"])
+
     def decode_into(self, dest: dict, offset: int, start: int = 0,
                     limit: int | None = None) -> int:
         """One-copy landing of rows [start, start+k) at dest[...][offset:].
@@ -199,7 +414,10 @@ class WireBatch:
         meta, recs = self._parsed()
         if limit is None:
             limit = self.rows
-        return _decode_rows_into(meta, recs, dest, offset, start, limit)
+        if self._cache is None:
+            self._cache = _new_cache()
+        return _decode_rows_into(meta, recs, dest, offset, start, limit,
+                                 self._cache)
 
     def __getitem__(self, key):
         if key in self._arrays:
@@ -209,9 +427,9 @@ class WireBatch:
         for m in meta:
             if m["nd"]:
                 if m["k"] == key:
-                    arr = np.frombuffer(
-                        recs[i], dtype=np.dtype(m["dt"])).reshape(
-                            m["sh"]).copy()
+                    if self._cache is None:
+                        self._cache = _new_cache()
+                    arr = _decode_leaf_full(m, recs[i], self._cache)
                     self._arrays[key] = arr
                     return arr
                 i += 1
@@ -292,7 +510,8 @@ class SocketIngestServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  max_pending: int = 64, idle_grace_s: float = 5.0,
-                 param_wire_dtype: str = "bfloat16"):
+                 param_wire_dtype: str = "bfloat16",
+                 wire_codec: str = "delta-deflate"):
         """param_wire_dtype: dtype for float params on the wire.
         "bfloat16" (default) halves the weight-broadcast bytes — the
         round-3 soak measured param pulls saturating a bandwidth-
@@ -300,12 +519,19 @@ class SocketIngestServer:
         compute in bf16 anyway (the receiver upcasts to f32, so only
         the bf16 rounding of the values survives — a behavior-policy
         perturbation far below the eps-greedy noise floor). Set
-        "float32" for bit-exact distribution."""
+        "float32" for bit-exact distribution.
+
+        wire_codec: experience codec this server is willing to grant in
+        the connect-time hello negotiation ("delta-deflate" default;
+        "raw" is the escape hatch that forces every peer to plain
+        payloads). Decode is always codec-capable — the setting only
+        controls what MSG_HELLO_ACK offers."""
         if param_wire_dtype not in ("bfloat16", "float32"):
             raise ValueError(
                 f"param_wire_dtype must be 'bfloat16' or 'float32', "
                 f"got {param_wire_dtype!r}")
         self._wire_dtype = param_wire_dtype
+        self._codec = _check_codec(wire_codec)
         self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
         self._dropped = 0
         # wire accounting (payload bytes; headers are ~17B noise):
@@ -313,6 +539,7 @@ class SocketIngestServer:
         # experience in vs params out is THE contended resource on
         # bandwidth-constrained links (PERF.md "Live soak")
         self._bytes_in = 0
+        self._raw_bytes_in = 0  # what _bytes_in would be uncompressed
         self._bytes_out = 0
         self._params: tuple[Any, int] = (None, -1)
         self._params_blob: bytes | None = pickle.dumps((None, -1))
@@ -411,6 +638,20 @@ class SocketIngestServer:
         return self._bytes_in
 
     @property
+    def raw_bytes_in(self) -> int:
+        """What bytes_in would have been with no wire codec (the
+        decoded size of every received experience leaf)."""
+        return self._raw_bytes_in
+
+    @property
+    def wire_compression_ratio(self) -> float:
+        """raw/wire byte ratio over all experience received so far
+        (1.0 = no savings; larger is better). 0.0 before any traffic."""
+        with self._conns_lock:
+            return (self._raw_bytes_in / self._bytes_in
+                    if self._bytes_in else 0.0)
+
+    @property
     def bytes_out(self) -> int:
         """Param blob bytes served to remote actor hosts."""
         return self._bytes_out
@@ -489,7 +730,19 @@ class SocketIngestServer:
                 if msg is None:
                     return  # peer closed: actor loss is tolerated
                 mtype, payload = msg
-                if mtype == MSG_EXPERIENCE:
+                if mtype in (MSG_EXPERIENCE, MSG_EXPERIENCE_C):
+                    # enqueue the payload with decode deferred (WireBatch):
+                    # the ingest thread lands the bytes straight in its
+                    # staging block with one copy instead of this reader
+                    # materializing a full dict of array copies per
+                    # message. Parse the header here so a corrupt frame
+                    # faults THIS connection, not the consumer. Codec
+                    # payloads (MSG_EXPERIENCE_C) are self-describing
+                    # per leaf, so decode needs no per-connection state.
+                    batch = WireBatch(payload)
+                    batch.rows  # noqa: B018 - framing validation
+                    raw = batch.raw_nbytes if mtype == MSG_EXPERIENCE_C \
+                        else len(payload)
                     # ever_connected latches HERE, not on accept: a
                     # param-only probe (monitoring, or an actor host
                     # that died waiting for params) is not a producer,
@@ -505,15 +758,21 @@ class SocketIngestServer:
                     with self._conns_lock:
                         self._ever_connected = True
                         self._bytes_in += len(payload)
-                    # enqueue the payload with decode deferred (WireBatch):
-                    # the ingest thread lands the bytes straight in its
-                    # staging block with one copy instead of this reader
-                    # materializing a full dict of array copies per
-                    # message. Parse the header here so a corrupt frame
-                    # faults THIS connection, not the consumer.
-                    batch = WireBatch(payload)
-                    batch.rows  # noqa: B018 - framing validation
+                        self._raw_bytes_in += raw
                     self.send_experience(batch)
+                elif mtype == MSG_HELLO:
+                    # codec negotiation: grant the configured codec iff
+                    # the client offered it; else raw. An OLD client
+                    # never sends a hello and keeps raw MSG_EXPERIENCE.
+                    try:
+                        offered = json.loads(bytes(payload)).get(
+                            "codecs", [])
+                    except (ValueError, AttributeError):
+                        offered = []
+                    grant = self._codec if self._codec in offered \
+                        else "raw"
+                    _send_msg(conn, MSG_HELLO_ACK,
+                              json.dumps({"codec": grant}).encode())
                 elif mtype == MSG_PARAMS_REQ:
                     blob = self._param_blob()
                     with self._conns_lock:
@@ -591,15 +850,28 @@ class SocketTransport:
     connection it attempts one reconnect and otherwise counts the batch
     as dropped (Ape-X ingest is lossy-tolerant; the actor keeps
     generating experience for when the learner returns).
+
+    wire_codec is OFFERED at connect time (MSG_HELLO) and used only if
+    the server acks it; an old server ignores the hello, the ack read
+    times out (hello_timeout), and the connection falls back to raw —
+    negotiation reruns on every reconnect, so a learner restart onto a
+    different build renegotiates transparently.
     """
 
-    def __init__(self, host: str, port: int, connect_timeout: float = 10.0):
+    def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
+                 wire_codec: str = "delta-deflate",
+                 hello_timeout: float = 2.0):
         self._addr = (host, port)
         self._timeout = connect_timeout
+        self._codec = _check_codec(wire_codec)
+        self._hello_timeout = hello_timeout
+        self._negotiated: str = "raw"  # per-connection, set on connect
         self._sock: socket.socket | None = None
         self._param_sock: socket.socket | None = None
         self._dropped = 0
-        self._bytes_out = 0  # experience payload bytes shipped
+        self._bytes_out = 0      # experience payload bytes shipped
+        self._raw_bytes_out = 0  # what they'd be uncompressed
+        self._encode_ms = 0.0    # cumulative wall-ms inside encode_batch
         self._bytes_in = 0   # param blob bytes pulled
         # independent locks: a param pull blocking on the network (up to
         # the connect timeout) must not stall the actor threads' experience
@@ -612,15 +884,53 @@ class SocketTransport:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
+    def _connect_experience(self) -> socket.socket:
+        """Connect the experience socket and negotiate the wire codec.
+        Sets self._negotiated; any failure mode (old server ignoring
+        the hello, timeout, garbled ack) degrades to raw, never to an
+        error — raw MSG_EXPERIENCE is universally understood."""
+        sock = self._connect()
+        self._negotiated = "raw"
+        if self._codec != "raw":
+            try:
+                _send_msg(sock, MSG_HELLO,
+                          json.dumps({"codecs": [self._codec]}).encode())
+                sock.settimeout(self._hello_timeout)
+                msg = _recv_msg(sock)
+                if msg is not None and msg[0] == MSG_HELLO_ACK:
+                    grant = json.loads(bytes(msg[1])).get("codec")
+                    if grant in WIRE_CODECS:
+                        self._negotiated = grant
+            except (OSError, ValueError):
+                pass  # old server / timeout / garbage ack -> raw
+            finally:
+                sock.settimeout(self._timeout)
+        return sock
+
     def send_experience(self, batch: dict) -> None:
-        payload = encode_batch(batch)
+        # encode under the send lock: the payload's codec must match
+        # THIS connection's negotiation, which a mid-call reconnect can
+        # change (it re-encodes in that case — reconnects are rare)
         with self._send_lock:
+            payload: bytes | None = None
+            payload_codec: str | None = None
             for _ in range(2):  # current socket, then one reconnect
                 try:
                     if self._sock is None:
-                        self._sock = self._connect()
-                    _send_msg(self._sock, MSG_EXPERIENCE, payload)
+                        self._sock = self._connect_experience()
+                    codec = self._negotiated
+                    if payload is None or payload_codec != codec:
+                        t0 = time.perf_counter()
+                        payload = encode_batch(batch, codec)
+                        self._encode_ms += (time.perf_counter() - t0) * 1e3
+                        payload_codec = codec
+                    mtype = MSG_EXPERIENCE_C if codec != "raw" \
+                        else MSG_EXPERIENCE
+                    _send_msg(self._sock, mtype, payload)
                     self._bytes_out += len(payload)
+                    self._raw_bytes_out += sum(
+                        v.nbytes for v in batch.values()
+                        if isinstance(v, np.ndarray))
                     return
                 except OSError:
                     if self._sock is not None:
@@ -687,6 +997,30 @@ class SocketTransport:
     def bytes_out(self) -> int:
         """Experience payload bytes shipped to the learner host."""
         return self._bytes_out
+
+    @property
+    def raw_bytes_out(self) -> int:
+        """Uncompressed array bytes of everything shipped — the
+        numerator of wire_compression_ratio."""
+        return self._raw_bytes_out
+
+    @property
+    def wire_compression_ratio(self) -> float:
+        """raw/wire ratio over all experience shipped (1.0 = no
+        savings; larger is better). 0.0 before any traffic."""
+        return (self._raw_bytes_out / self._bytes_out
+                if self._bytes_out else 0.0)
+
+    @property
+    def negotiated_codec(self) -> str:
+        """Codec agreed with the current learner connection ("raw"
+        until a hello/ack has succeeded)."""
+        return self._negotiated
+
+    @property
+    def encode_ms(self) -> float:
+        """Cumulative wall-ms spent encoding experience payloads."""
+        return self._encode_ms
 
     @property
     def bytes_in(self) -> int:

@@ -192,6 +192,23 @@ class ParallelConfig:
 
 
 @dataclass(frozen=True)
+class CommConfig:
+    """Cross-host experience/param transport (comm/socket_transport).
+
+    wire_codec: per-leaf experience compression on the ingest wire —
+    "delta-deflate" (default) ships uint8 frame leaves as XOR-delta vs
+    the previous row + zlib deflate, bit-packs bools, deflates small
+    ints, leaves floats raw; "raw" is the escape hatch (and what either
+    peer silently degrades to when the other side predates the codec —
+    negotiation happens per connection, see MSG_HELLO in
+    comm/socket_transport.py). The ingest wire is the #1 measured live
+    bottleneck (PERF.md round-4: 10.5 MB/s, ~9.7KB/transition), so the
+    default is on."""
+
+    wire_codec: str = "delta-deflate"
+
+
+@dataclass(frozen=True)
 class ObsConfig:
     """Observability layer (ape_x_dqn_tpu/obs): span tracing, metric
     registry, heartbeat stall watchdog. Disabled by default — the
@@ -240,6 +257,7 @@ class RunConfig:
     actors: ActorConfig = field(default_factory=ActorConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    comm: CommConfig = field(default_factory=CommConfig)
     # observability (ape_x_dqn_tpu/obs): off by default; enable with
     # --set obs.enabled=true [--set obs.trace_path=trace.json ...]
     obs: ObsConfig = field(default_factory=ObsConfig)

@@ -76,4 +76,44 @@ uint64_t apex_unpack_offsets(const uint8_t* buf, uint64_t len,
     return (off == len) ? i : (uint64_t)-1;
 }
 
+// XOR one row against another, word-wise with a byte tail. The wire
+// codec's delta transform (comm/socket_transport.py "delta-deflate"):
+// temporally adjacent frame rows XOR to mostly-zero bytes, which
+// deflate then collapses.
+static inline void xor_row(uint8_t* dst, const uint8_t* a,
+                           const uint8_t* b, uint64_t n) {
+    uint64_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t wa, wb;
+        std::memcpy(&wa, a + i, 8);
+        std::memcpy(&wb, b + i, 8);
+        wa ^= wb;
+        std::memcpy(dst + i, &wa, 8);
+    }
+    for (; i < n; ++i) dst[i] = a[i] ^ b[i];
+}
+
+// Encode: dst row 0 = src row 0 (raw anchor); dst row i = src[i] ^
+// src[i-1]. dst and src must not alias.
+void apex_delta_encode(uint8_t* dst, const uint8_t* src, uint64_t rows,
+                       uint64_t row_bytes) {
+    if (!dst || !src || rows == 0) return;
+    std::memcpy(dst, src, row_bytes);
+    for (uint64_t r = 1; r < rows; ++r)
+        xor_row(dst + r * row_bytes, src + r * row_bytes,
+                src + (r - 1) * row_bytes, row_bytes);
+}
+
+// Decode IN PLACE: buf[i] ^= buf[i-1] for i = 1..rows-1 — the prefix
+// undo that turns landed delta rows back into absolute rows directly in
+// the preallocated staging block (row 0 must already be absolute; the
+// caller XORs the continuation row in when a batch splits across
+// staging buffers).
+void apex_delta_undo(uint8_t* buf, uint64_t rows, uint64_t row_bytes) {
+    if (!buf) return;
+    for (uint64_t r = 1; r < rows; ++r)
+        xor_row(buf + r * row_bytes, buf + r * row_bytes,
+                buf + (r - 1) * row_bytes, row_bytes);
+}
+
 }  // extern "C"

@@ -150,6 +150,22 @@ def _fmt_ingest(summary: dict[str, Any]) -> list[str]:
         lines.append(f"  last coalesce width    {_n(width)} blocks/add "
                      f"dispatch (1 = idle-drain, >1 = full-buffer "
                      f"add_many)")
+    ratio = gauges.get("wire_compression_ratio")
+    if ratio is not None:
+        lines.append(f"  wire compression       {float(ratio):.2f}x "
+                     f"raw/wire (delta-deflate codec; healthy ≥2x on "
+                     f"frame traffic, 1.0 = raw peer)")
+        if float(ratio) < 1.5:
+            lines.append("    ⚠ wire ratio <1.5x: peer negotiated raw "
+                         "(old build / comm.wire_codec=raw) or traffic "
+                         "is float-dominated — the ingest link runs "
+                         "uncompressed")
+    dec = gauges.get("ingest_decode_ms")
+    if dec is not None:
+        lines.append(f"  last put decode        {float(dec):.2f} ms "
+                     f"(inflate + delta-undo + staging copy; healthy "
+                     f"<10ms per message — beyond that decode eats the "
+                     f"ingest thread's budget)")
     # ingest-bound flags: a persistently full staging buffer means
     # device adds can't keep up with actor arrivals; a replay.add span
     # eating a large share of host wall-clock means adds steal the

@@ -43,7 +43,8 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     """
     n = num_actors or cfg.actors.num_actors
     stop_event = stop_event or threading.Event()
-    transport = SocketTransport(host, port)
+    transport = SocketTransport(host, port,
+                                wire_codec=cfg.comm.wire_codec)
 
     # wait for the learner to publish a first param set
     deadline = time.monotonic() + wait_for_params_s
@@ -119,6 +120,10 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     return {"frames": sum(frames), "actors": n,
             "dropped": transport.dropped, "errors": errors,
             "bytes_out": transport.bytes_out,
+            "wire_codec": transport.negotiated_codec,
+            "wire_compression_ratio": round(
+                transport.wire_compression_ratio, 3),
+            "encode_ms": round(transport.encode_ms, 1),
             "param_bytes_in": transport.bytes_in,
             "last_param_version": server.params_version}
 

@@ -438,10 +438,19 @@ class ApexDriver:
                 self._stager.drain()
             self.obs.gauge("ingest_staging_occupancy",
                            self._stager.occupancy())
+            self.obs.gauge("ingest_decode_ms",
+                           self._stager.last_put_decode_ms)
         else:
             self._stage.append(batch)
             self._stage_n += n
             self._flush_stage()
+        # wire codec accounting: WireBatch knows both its wire size and
+        # its decoded size (header-only); dict batches came in locally
+        # and have no wire footprint to report
+        wire = getattr(batch, "wire_nbytes", 0)
+        if wire:
+            self.obs.gauge("wire_compression_ratio",
+                           batch.raw_nbytes / wire)
         self.frames.add(frames)
         with self._lock:
             self._frames_total += frames

@@ -36,6 +36,7 @@ legacy path) at force-flush during teardown.
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -74,6 +75,11 @@ class IngestStager:
         self._inflight: list[list] = [[] for _ in range(self.nb)]
         self._active = 0
         self._cursor = 0  # rows staged in the active buffer
+        # wire-codec decode accounting: cumulative wall-ms spent inside
+        # decode_into/dict landing (inflate + delta-undo + the one copy)
+        # — obs surfaces it as ingest_decode_ms per put
+        self.decode_ms = 0.0
+        self.last_put_decode_ms = 0.0
 
     # -- write side --------------------------------------------------------
 
@@ -94,20 +100,25 @@ class IngestStager:
         total = batch.rows if wire \
             else int(batch["priorities"].shape[0])
         start = 0
+        put_ms = 0.0
         while start < total:
             self._wait(self._active)
             buf = self._bufs[self._active]
             k = min(total - start, self.rows - self._cursor)
+            t0 = time.perf_counter()
             if wire:
                 batch.decode_into(buf, self._cursor, start, k)
             else:
                 for key in self._keys:
                     buf[key][self._cursor:self._cursor + k] = \
                         np.asarray(batch[key])[start:start + k]
+            put_ms += (time.perf_counter() - t0) * 1e3
             self._cursor += k
             start += k
             if self._cursor == self.rows:
                 self._ship_buffer()
+        self.last_put_decode_ms = put_ms
+        self.decode_ms += put_ms
 
     def _ship_buffer(self) -> None:
         """Full buffer -> one add_many dispatch; rotate to the next

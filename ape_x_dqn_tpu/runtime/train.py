@@ -202,7 +202,8 @@ def main(argv: list[str] | None = None) -> int:
         from ape_x_dqn_tpu.comm.socket_transport import SocketIngestServer
         host, port = args.listen.rsplit(":", 1)
         server = transport = SocketIngestServer(
-            host, int(port), param_wire_dtype=args.param_wire_dtype)
+            host, int(port), param_wire_dtype=args.param_wire_dtype,
+            wire_codec=cfg.comm.wire_codec)
         print(f"ingest listening on {host}:{server.port}",
               file=sys.stderr, flush=True)
     if args.coordinator is not None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import os
 from typing import Any
 
+import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -59,6 +60,25 @@ class CheckpointManager:
             state = {**state,
                      _LAYOUT_KEY: np.asarray(STORAGE_LAYOUT_VERSION,
                                              np.int32)}
+        # orbax's StandardSave accepts 0-d ndarrays but rejects bare
+        # numpy scalars (np.generic) such as an np.int32 step counter;
+        # promote them so callers don't have to care
+        state = jax.tree.map(
+            lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+            state)
+        # orbax asserts (finalize_thread is None) if a save starts while
+        # the previous async save is still finalizing; drain it first.
+        # wait_until_finished only clears the handle when called from
+        # the thread that issued the previous save — the driver saves
+        # from both its train loop and its shutdown path, so a finished
+        # thread's handle can linger and still trip the assert; clear it.
+        self._mngr.wait_until_finished()
+        lock = getattr(self._mngr, "_finalize_thread_lock", None)
+        if lock is not None:
+            with lock:
+                ft = getattr(self._mngr, "_finalize_thread", None)
+                if ft is not None and not ft.is_alive():
+                    self._mngr._finalize_thread = None
         self._mngr.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self._mngr.wait_until_finished()
@@ -79,7 +99,11 @@ class CheckpointManager:
                 out = self._mngr.restore(
                     step, args=ocp.args.StandardRestore(template))
             else:
-                out = self._mngr.restore(step)
+                # a fresh manager has no handler registered for the
+                # saved item; an argless StandardRestore restores from
+                # the checkpoint's own metadata
+                out = self._mngr.restore(
+                    step, args=ocp.args.StandardRestore())
         except (ValueError, KeyError, TypeError) as e:
             # the raw Orbax structure-mismatch traceback names neither
             # the cause nor the way out; translate it

@@ -690,6 +690,167 @@ def bench_ingest_ab(args) -> dict:
     return out
 
 
+def _wire_ab_messages(n_msgs: int, n_wire: int = 8, f: int = 12,
+                      b: int = 12) -> list[dict]:
+    """Atari-like synthetic frame-ring experience messages: a static
+    background plus a few sprites drifting a few pixels per frame, so
+    temporally adjacent frames XOR to sparse deltas — the structure the
+    wire codec exploits. Pure-noise frames would understate the ratio
+    (noise is incompressible); real Atari frames compress better still
+    (larger static regions)."""
+    rng = np.random.default_rng(11)
+    hw = (84, 84)
+    bg = rng.integers(0, 40, hw, dtype=np.uint8)
+    msgs = []
+    for m in range(n_msgs):
+        segs = np.empty((n_wire, f, *hw), np.uint8)
+        for u in range(n_wire):
+            for i in range(f):
+                t = (m * n_wire + u) * f + i
+                fr = bg.copy()
+                for s in range(4):
+                    x = (3 * t * (s + 1)) % (hw[0] - 8)
+                    y = (2 * t * (s + 2)) % (hw[1] - 8)
+                    fr[x:x + 8, y:y + 8] = 60 + 40 * s
+                segs[u, i] = fr
+        msgs.append({
+            "seg_frames": segs,
+            "action": rng.integers(0, 18, (n_wire, b)).astype(np.int32),
+            "reward": rng.random((n_wire, b)).astype(np.float32),
+            "discount": np.ones((n_wire, b), np.float32),
+            "next_off": rng.integers(0, f, (n_wire, b)).astype(np.int32),
+            "priorities": (rng.random((n_wire, b)) + 0.1).astype(
+                np.float32),
+            "frames": n_wire * f,
+        })
+    return msgs
+
+
+def bench_wire_ab(args) -> dict:
+    """A/B the wire codec (comm/socket_transport delta-deflate) over a
+    REAL loopback socket pair: bytes/transition and transitions/s for
+    raw vs codec, both orders on fresh pairs, median-of-`--repeats` —
+    plus a bandwidth-capped arm (sender paced to --wire-ab-cap-mb MB/s,
+    the round-4 measured live link rate) showing items/s scaling with
+    the compression ratio, which is what the codec buys on a real NIC
+    (loopback has no bandwidth ceiling, so the uncapped arms mostly
+    measure encode/decode CPU)."""
+    import threading
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport)
+
+    n_wire, f, b = 8, 12, 12
+    msgs = _wire_ab_messages(6, n_wire, f, b)
+    iters = 8  # message-list replays per timed run
+    total_units = len(msgs) * iters * n_wire
+    transitions = total_units * b
+
+    def arm(codec: str, cap_mb_s: float | None = None) -> dict:
+        srv = SocketIngestServer("127.0.0.1", 0, wire_codec=codec)
+        tr = SocketTransport("127.0.0.1", srv.port, wire_codec=codec)
+        dest = {k: np.zeros_like(v) for k, v in msgs[0].items()
+                if isinstance(v, np.ndarray)}
+        got = {"units": 0}
+        done = threading.Event()
+
+        def consume() -> None:
+            while got["units"] < total_units:
+                m = srv.recv_experience(timeout=10)
+                if m is None:
+                    break
+                # land through the one-copy staging path so decode cost
+                # (inflate + delta-undo) is inside the measurement
+                m.decode_into(dest, 0, 0, n_wire)
+                got["units"] += m.rows
+            done.set()
+
+        thread = threading.Thread(target=consume, daemon=True)
+        t0 = time.monotonic()
+        thread.start()
+        for _ in range(iters):
+            for batch in msgs:
+                tr.send_experience(batch)
+                if cap_mb_s:
+                    # token-bucket pacing: a cap_mb_s link would have
+                    # taken bytes_out / cap seconds to carry what we
+                    # shipped so far — sleep off the surplus
+                    lag = (tr.bytes_out / (cap_mb_s * 1e6)
+                           - (time.monotonic() - t0))
+                    if lag > 0:
+                        time.sleep(lag)
+        done.wait(timeout=60)
+        dt = time.monotonic() - t0
+        out = {
+            "items_per_s": transitions / dt,
+            "bytes_per_transition": tr.bytes_out / transitions,
+            "ratio": tr.wire_compression_ratio,
+            "negotiated": tr.negotiated_codec,
+            "encode_ms_total": round(tr.encode_ms, 1),
+        }
+        tr.close()
+        srv.stop()
+        assert got["units"] == total_units, \
+            f"consumer saw {got['units']}/{total_units} units"
+        return out
+
+    out = {"denomination": "frame_ring", "units_per_msg": n_wire,
+           "transitions_per_unit": b, "cap_mb_s": args.wire_ab_cap_mb}
+    for order in ("raw_first", "codec_first"):
+        arms = ("raw", "delta-deflate") if order == "raw_first" \
+            else ("delta-deflate", "raw")
+        runs: dict[str, list] = {"raw": [], "delta-deflate": []}
+        last = {}
+        for _ in range(args.repeats):
+            for codec in arms:
+                r = arm(codec)
+                runs[codec].append(r["items_per_s"])
+                last[codec] = r
+        out[order] = {
+            codec: {"items_per_s": spread(runs[codec]),
+                    "bytes_per_transition": round(
+                        last[codec]["bytes_per_transition"], 1),
+                    "ratio": round(last[codec]["ratio"], 2),
+                    "negotiated": last[codec]["negotiated"]}
+            for codec in runs}
+        log(f"wire A/B [{order}]: raw "
+            f"{out[order]['raw']['bytes_per_transition']} B/transition "
+            f"@ {spread(runs['raw'])} items/s vs codec "
+            f"{out[order]['delta-deflate']['bytes_per_transition']} "
+            f"B/transition @ {spread(runs['delta-deflate'])} items/s "
+            f"(ratio {out[order]['delta-deflate']['ratio']}x)")
+    capped: dict[str, list] = {"raw": [], "delta-deflate": []}
+    for _ in range(args.repeats):
+        for codec in ("raw", "delta-deflate"):
+            capped[codec].append(
+                arm(codec, cap_mb_s=args.wire_ab_cap_mb)["items_per_s"])
+    out["bandwidth_capped"] = {
+        codec: spread(capped[codec]) for codec in capped}
+    out["bandwidth_capped"]["speedup"] = round(
+        spread(capped["delta-deflate"])["median"]
+        / spread(capped["raw"])["median"], 2)
+    log(f"wire A/B capped @ {args.wire_ab_cap_mb} MB/s: raw "
+        f"{spread(capped['raw'])} vs codec "
+        f"{spread(capped['delta-deflate'])} items/s -> "
+        f"{out['bandwidth_capped']['speedup']}x")
+    return out
+
+
+def wire_codec_summary() -> dict:
+    """Cheap in-memory codec ratio on the Atari-like synthetic frames —
+    recorded in every default bench run so BENCH artifacts carry the
+    wire ratio without the full --wire-ab socket harness."""
+    from ape_x_dqn_tpu.comm.socket_transport import encode_batch
+
+    msgs = _wire_ab_messages(2)
+    raw = sum(len(encode_batch(m, "raw")) for m in msgs)
+    comp = sum(len(encode_batch(m, "delta-deflate")) for m in msgs)
+    transitions = len(msgs) * 8 * 12
+    return {"ratio": round(raw / comp, 2),
+            "raw_bytes_per_transition": round(raw / transitions, 1),
+            "codec_bytes_per_transition": round(comp / transitions, 1)}
+
+
 def bench_h2d(mb: int = 64, repeats: int = 3, iters: int = 4) -> list[float]:
     """Raw host->device link bandwidth: pure `device_put` MB/s of a
     pinned 64MB buffer, no compute. Round-4 verdict weak #1: the ingest
@@ -783,6 +944,18 @@ def main() -> None:
                    "--storage, INSTEAD of the main flagship bench "
                    "(the stdout metric is then the old-arm offline "
                    "median)")
+    p.add_argument("--wire-ab", action="store_true",
+                   help="run the wire-codec A/B (raw vs delta-deflate "
+                   "experience compression over a real loopback socket "
+                   "pair, both orders, median-of-`--repeats` per arm, "
+                   "plus a bandwidth-capped arm paced to "
+                   "--wire-ab-cap-mb): bytes/transition + items/s, "
+                   "recorded under secondary.wire_ab (PERF.md 'Wire "
+                   "codec'). Runs INSTEAD of the main flagship bench")
+    p.add_argument("--wire-ab-cap-mb", type=float, default=10.5,
+                   help="simulated link MB/s for the capped wire-ab "
+                   "arm (default = the round-4 measured live ingest "
+                   "rate)")
     p.add_argument("--ab-batch-size", type=int, default=64,
                    help="batch size for the prefetch A/B arms (small "
                    "enough to iterate on a CPU host; raise on a real "
@@ -819,6 +992,17 @@ def main() -> None:
                           "live_gap": ab["live_gap_new"]},
         }), flush=True)
         return
+    if args.wire_ab:
+        ab = bench_wire_ab(args)
+        print(json.dumps({
+            "metric": "wire_bytes_per_transition",
+            "value": ab["raw_first"]["delta-deflate"][
+                "bytes_per_transition"],
+            "unit": "bytes",
+            "vs_baseline": ab["raw_first"]["delta-deflate"]["ratio"],
+            "secondary": {"wire_ab": ab},
+        }), flush=True)
+        return
     h2d_rates = bench_h2d(repeats=args.repeats)
     log(f"h2d link: {spread(h2d_rates)} MB/s (pure device_put, 64MB "
         f"buffer) — read ingest items/s against this")
@@ -840,6 +1024,7 @@ def main() -> None:
         "ingest_items_per_s": spread(ingest_rates),
         "h2d_mb_per_s": spread(h2d_rates),
         "sample_chunk": args.sample_chunk,
+        "wire_codec": wire_codec_summary(),
     }
     flops = train_step_flops_analytic(args.batch_size)
     achieved_tflops = gsps * flops / 1e12

@@ -30,6 +30,23 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Module-boundary jax.clear_caches() — the same fix
+    runtime/suite.py:train_one_game applies between games. The full
+    one-command suite accumulates compiled executables across ~200
+    tests and reproducibly dies in native XLA teardown near the end of
+    collection-order runs (ROADMAP.md 'Tier-1 invocation'); dropping
+    the compilation caches at each test module's end keeps the
+    native-side footprint bounded without perturbing any single
+    module's warm-jit behavior."""
+    yield
+    import gc
+
+    gc.collect()
+    jax.clear_caches()
+
+
 def pytest_addoption(parser):
     parser.addoption("--run-slow", action="store_true", default=False,
                      help="run slow integration tests (full CartPole solve)")

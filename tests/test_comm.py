@@ -157,6 +157,210 @@ def test_server_get_params_caches_deserialized_tree():
         server.stop()
 
 
+# -- wire codec (delta-deflate experience compression) ----------------------
+
+
+def _codec_batch(seed=0, n=16):
+    """Frame-heavy batch with every leaf class the codec handles:
+    frame-like uint8 (xd), bools (bp), small ints (d), floats (raw)."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 60, (4, 84, 84), dtype=np.uint8)
+    frames = np.stack([np.roll(base, i, axis=1) for i in range(n)])
+    return {
+        "seg_frames": frames,
+        "action": rng.integers(0, 18, (n,)).astype(np.int32),
+        "done": rng.random(n) < 0.1,
+        "priorities": (rng.random(n) + 0.1).astype(np.float32),
+        "actor": 1, "frames": n,
+    }
+
+
+def _assert_batches_equal(got, batch):
+    for k, v in batch.items():
+        if isinstance(v, np.ndarray):
+            assert got[k].dtype == v.dtype, k
+            np.testing.assert_array_equal(got[k], v, err_msg=k)
+        else:
+            assert got[k] == v, k
+
+
+def test_wire_codec_shrinks_and_roundtrips():
+    """Frame traffic must compress >=2x (the adoption bar) and decode
+    bitwise-identically, through both decode forms."""
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        WireBatch, decode_batch_into)
+
+    batch = _codec_batch()
+    raw = encode_batch(batch, "raw")
+    comp = encode_batch(batch, "delta-deflate")
+    assert len(comp) * 2 < len(raw)
+    _assert_batches_equal(decode_batch(comp), batch)
+    wb = WireBatch(comp)
+    assert wb.raw_nbytes > wb.wire_nbytes
+    dest = {k: np.zeros_like(v) for k, v in batch.items()
+            if isinstance(v, np.ndarray)}
+    k1, rows, scalars = decode_batch_into(comp, dest, 0, 0, 9)
+    wb.decode_into(dest, 9, 9)  # split continuation on a fresh WireBatch
+    assert rows == 16 and scalars["actor"] == 1
+    for k in dest:
+        np.testing.assert_array_equal(dest[k], batch[k], err_msg=k)
+
+
+def test_wire_codec_interop_matrix():
+    """Every (server wire_codec) x (client wire_codec) combination over
+    a REAL socket pair delivers bitwise-identical experience, and the
+    negotiated codec is delta-deflate iff both sides want it."""
+    batch = _codec_batch(seed=3)
+    for srv_codec in ("raw", "delta-deflate"):
+        for cli_codec in ("raw", "delta-deflate"):
+            server = SocketIngestServer("127.0.0.1", 0,
+                                        wire_codec=srv_codec)
+            client = SocketTransport("127.0.0.1", server.port,
+                                     wire_codec=cli_codec)
+            try:
+                client.send_experience(batch)
+                got = server.recv_experience(timeout=5.0)
+                assert got is not None, (srv_codec, cli_codec)
+                _assert_batches_equal(got, batch)
+                want = "delta-deflate" \
+                    if srv_codec == cli_codec == "delta-deflate" else "raw"
+                assert client.negotiated_codec == want
+                if want == "delta-deflate":
+                    assert server.wire_compression_ratio > 1.5
+                    assert client.wire_compression_ratio > 1.5
+            finally:
+                client.close()
+                server.stop()
+
+
+def test_wire_codec_raw_fallback_on_silent_server():
+    """An OLD server never acks MSG_HELLO (unknown types fall through
+    its reader) — the client must time out and degrade to raw, and the
+    raw message must still arrive. Simulated with a minimal reader that
+    ignores everything but experience messages."""
+    import socket as socket_mod
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        MSG_EXPERIENCE, _recv_msg)
+
+    listener = socket_mod.socket(socket_mod.AF_INET,
+                                 socket_mod.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    got: list = []
+
+    def old_server():
+        conn, _ = listener.accept()
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                return
+            if msg[0] == MSG_EXPERIENCE:  # hellos silently ignored
+                got.append(msg[1])
+                return
+
+    thread = threading.Thread(target=old_server, daemon=True)
+    thread.start()
+    client = SocketTransport("127.0.0.1", listener.getsockname()[1],
+                             hello_timeout=0.3)
+    try:
+        batch = _codec_batch(seed=4)
+        client.send_experience(batch)
+        assert client.negotiated_codec == "raw"
+        thread.join(timeout=5)
+        assert got, "old server never received the raw experience"
+        _assert_batches_equal(decode_batch(got[0]), batch)
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_wire_codec_cross_decode_native_python(monkeypatch):
+    """The C++ delta transform and the numpy fallback must be
+    wire-compatible in BOTH directions: payloads encoded with one must
+    decode bitwise through the other (a C++-enabled learner host talks
+    to a Python-only actor host and vice versa)."""
+    if not native.have_delta_native():
+        pytest.skip("native delta unavailable; nothing to cross-check")
+    batch = _codec_batch(seed=5)
+    native_payload = encode_batch(batch, "delta-deflate")
+    native_decode = decode_batch(native_payload)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    monkeypatch.setattr(native, "_has_delta", False)
+    python_payload = encode_batch(batch, "delta-deflate")
+    _assert_batches_equal(decode_batch(native_payload), batch)
+    monkeypatch.undo()
+    assert native.have_delta_native()
+    _assert_batches_equal(decode_batch(python_payload), batch)
+    _assert_batches_equal(native_decode, batch)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_wire_codec_fuzz_roundtrip(seed):
+    """Random leaf shapes/dtypes/row sizes round-trip bitwise under the
+    codec, through decode_batch AND the staged decode_batch_into with a
+    random split point."""
+    from ape_x_dqn_tpu.comm.socket_transport import decode_batch_into
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 40))
+    batch = {"priorities": rng.random(n).astype(np.float32)}
+    dtypes = [np.uint8, np.int8, np.int32, np.int64, np.float32,
+              np.float64, np.bool_]
+    for i in range(int(rng.integers(1, 6))):
+        nd = int(rng.integers(0, 3))
+        tail = tuple(int(rng.integers(1, 64)) for _ in range(nd))
+        dt = dtypes[int(rng.integers(0, len(dtypes)))]
+        shape = (n,) + tail
+        if dt == np.bool_:
+            batch[f"leaf{i}"] = rng.random(shape) < 0.2
+        elif np.issubdtype(dt, np.integer):
+            batch[f"leaf{i}"] = rng.integers(0, 7, shape).astype(dt)
+        else:
+            batch[f"leaf{i}"] = rng.random(shape).astype(dt)
+    payload = encode_batch(batch, "delta-deflate")
+    _assert_batches_equal(decode_batch(payload), batch)
+    dest = {k: np.zeros_like(v) for k, v in batch.items()}
+    cut = int(rng.integers(0, n + 1))
+    decode_batch_into(payload, dest, 0, 0, cut)
+    decode_batch_into(payload, dest, cut, cut)
+    for k in dest:
+        np.testing.assert_array_equal(dest[k], batch[k], err_msg=k)
+
+
+def test_wire_codec_truncated_and_corrupt_rejected():
+    """Corrupt/truncated codec streams must reject with ValueError (the
+    server reader drops such connections), never decode garbage."""
+    import json as json_mod
+
+    from ape_x_dqn_tpu.comm import native as native_mod
+
+    batch = _codec_batch(seed=7)
+    payload = encode_batch(batch, "delta-deflate")
+    # flip bytes inside the compressed frame region
+    corrupt = bytearray(payload)
+    corrupt[len(corrupt) // 2] ^= 0xFF
+    corrupt[-100] ^= 0xFF
+    with pytest.raises(ValueError):
+        decode_batch(bytes(corrupt))
+    # truncate a leaf's deflate stream but keep the framing valid:
+    # re-pack with the last record cut short
+    recs = [bytes(r) for r in native_mod.unpack_records_mv(payload)]
+    meta = json_mod.loads(recs[0])
+    assert any(m.get("enc") for m in meta)  # codec leaves present
+    truncated = native_mod.pack_records(recs[:-1] + [recs[-1][:10]])
+    with pytest.raises(ValueError):
+        decode_batch(truncated)
+    # a stream inflating to the WRONG size (valid zlib, bad length):
+    # swap one encoded leaf's bytes for a short valid deflate stream
+    xd_idx = 1 + [j for j, m in enumerate(
+        [m for m in meta if m["nd"]]) if m.get("enc") == "xd"][0]
+    recs[xd_idx] = zlib.compress(b"short", 1)
+    with pytest.raises(ValueError):
+        decode_batch(native_mod.pack_records(recs))
+
+
 # -- socket transport --------------------------------------------------------
 
 

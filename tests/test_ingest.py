@@ -6,7 +6,9 @@
   stager) — the accounting must survive the staging rewrite exactly
 - bitwise ingest parity: the same recorded wire stream lands identical
   replay-bound blocks through decode-into-staging as through the legacy
-  decode_batch + concatenate path, for flat + frame-ring + r2d2
+  decode_batch + concatenate path, for flat + frame-ring + r2d2 — and
+  the delta-deflate wire codec must land the same bits as raw through
+  both paths (split decodes exercise the delta continuation cache)
 - IngestStager unit behavior: boundary splitting, coalesced ships,
   drain compaction, tail exposure
 """
@@ -209,6 +211,40 @@ def test_ingest_parity_zero_copy_vs_legacy(cfg_fn):
         if a is not None:
             assert a.dtype == b.dtype, k
             np.testing.assert_array_equal(a, b, err_msg=k)
+
+
+@pytest.mark.parametrize("cfg_fn", [_flat_cfg, _ring_cfg, _r2d2_cfg],
+                         ids=["flat", "frame_ring", "r2d2"])
+def test_ingest_parity_codec_vs_raw(cfg_fn):
+    """The delta-deflate wire codec must be invisible to replay: the
+    SAME recorded stream encoded raw vs codec lands bitwise-identical
+    blocks through the zero-copy staging path (split decodes, delta
+    continuation across buffer boundaries and all) AND through the
+    legacy decode_batch path, in every denomination."""
+    probe = ApexDriver(cfg_fn())
+    sizes = [3, 7, 1, 6, 5, 2]
+    raw_payloads, codec_payloads = [], []
+    for i, n in enumerate(sizes):
+        b = _synth_batch(probe, n, seed=100 + i, frames=n)
+        raw_payloads.append(encode_batch(b, "raw"))
+        codec_payloads.append(encode_batch(b, "delta-deflate"))
+    del probe
+    raw = _record_stream(lambda: cfg_fn(), sizes, raw_payloads)
+    codec = _record_stream(lambda: cfg_fn(), sizes, codec_payloads)
+    legacy = _record_stream(
+        lambda: cfg_fn().replace(
+            replay=dataclasses.replace(cfg_fn().replay,
+                                       ingest_zero_copy=False)),
+        sizes, codec_payloads)
+    for other in (codec, legacy):
+        assert raw[1] == other[1]  # dropped
+        assert raw[2] == other[2]  # frames_total
+        for k in raw[0]:
+            a, b = raw[0][k], other[0][k]
+            assert (a is None) == (b is None), k
+            if a is not None:
+                assert a.dtype == b.dtype, k
+                np.testing.assert_array_equal(a, b, err_msg=k)
 
 
 # -- IngestStager unit behavior --------------------------------------------
