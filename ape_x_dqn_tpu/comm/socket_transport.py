@@ -65,6 +65,7 @@ from typing import Any
 import numpy as np
 
 from ape_x_dqn_tpu.comm import native
+from ape_x_dqn_tpu.obs.health import make_lock
 
 MAGIC = 0x41504558  # 'APEX'
 MSG_EXPERIENCE = 1
@@ -533,18 +534,18 @@ class SocketIngestServer:
         self._wire_dtype = param_wire_dtype
         self._codec = _check_codec(wire_codec)
         self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
-        self._dropped = 0
+        self._dropped = 0  # guarded-by: _conns_lock
         # wire accounting (payload bytes; headers are ~17B noise):
         # lets a soak/driver publish the link's MB/s budget —
         # experience in vs params out is THE contended resource on
         # bandwidth-constrained links (PERF.md "Live soak")
-        self._bytes_in = 0
-        self._raw_bytes_in = 0  # what _bytes_in would be uncompressed
-        self._bytes_out = 0
-        self._params: tuple[Any, int] = (None, -1)
-        self._params_blob: bytes | None = pickle.dumps((None, -1))
-        self._params_cache: tuple[Any, int] | None = None
-        self._lock = threading.Lock()
+        self._bytes_in = 0  # guarded-by: _conns_lock
+        self._raw_bytes_in = 0  # guarded-by: _conns_lock
+        self._bytes_out = 0  # guarded-by: _conns_lock
+        self._params: tuple[Any, int] = (None, -1)  # guarded-by: _lock
+        self._params_blob: bytes | None = pickle.dumps((None, -1))  # guarded-by: _lock
+        self._params_cache: tuple[Any, int] | None = None  # guarded-by: _lock
+        self._lock = make_lock("ingest_server._lock")
         self._stop = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -557,11 +558,11 @@ class SocketIngestServer:
         # load-bearing for fleet lifetime (a stale read can terminate a
         # multihost run early), so mutations take an explicit lock
         # rather than leaning on the GIL's list-op atomicity
-        self._conns: list[socket.socket] = []
-        self._conns_lock = threading.Lock()
+        self._conns: list[socket.socket] = []  # guarded-by: _conns_lock
+        self._conns_lock = make_lock("ingest_server._conns_lock")
         self._idle_grace_s = idle_grace_s
-        self._last_disconnect: float | None = None
-        self._ever_connected = False
+        self._last_disconnect: float | None = None  # guarded-by: _conns_lock
+        self._ever_connected = False  # guarded-by: _conns_lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ingest-accept", daemon=True)
         self._accept_thread.start()
@@ -583,7 +584,10 @@ class SocketIngestServer:
             except queue.Full:
                 try:
                     self._q.get_nowait()
-                    self._dropped += 1
+                    # every reader thread and local actors land here on
+                    # a full queue; a bare += across threads loses drops
+                    with self._conns_lock:
+                        self._dropped += 1
                 except queue.Empty:
                     pass
 
@@ -865,19 +869,22 @@ class SocketTransport:
         self._timeout = connect_timeout
         self._codec = _check_codec(wire_codec)
         self._hello_timeout = hello_timeout
-        self._negotiated: str = "raw"  # per-connection, set on connect
-        self._sock: socket.socket | None = None
-        self._param_sock: socket.socket | None = None
-        self._dropped = 0
-        self._bytes_out = 0      # experience payload bytes shipped
-        self._raw_bytes_out = 0  # what they'd be uncompressed
-        self._encode_ms = 0.0    # cumulative wall-ms inside encode_batch
-        self._bytes_in = 0   # param blob bytes pulled
+        self._negotiated: str = "raw"  # guarded-by: _send_lock
+        self._sock: socket.socket | None = None  # guarded-by: _send_lock
+        self._param_sock: socket.socket | None = None  # guarded-by: _param_lock
+        self._dropped = 0  # guarded-by: _send_lock
+        self._bytes_out = 0  # guarded-by: _send_lock
+        self._raw_bytes_out = 0  # guarded-by: _send_lock
+        self._encode_ms = 0.0  # guarded-by: _send_lock
+        self._bytes_in = 0  # guarded-by: _param_lock
         # independent locks: a param pull blocking on the network (up to
         # the connect timeout) must not stall the actor threads' experience
-        # sends — they use different sockets and share no state
-        self._send_lock = threading.Lock()
-        self._param_lock = threading.Lock()
+        # sends — they use different sockets and share no state.
+        # (_bytes_out and friends: payload bytes shipped vs their
+        # uncompressed size, cumulative encode wall-ms, param blob
+        # bytes pulled — the soak's link-budget accounting)
+        self._send_lock = make_lock("transport._send_lock")
+        self._param_lock = make_lock("transport._param_lock")
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
@@ -890,7 +897,8 @@ class SocketTransport:
         the hello, timeout, garbled ack) degrades to raw, never to an
         error — raw MSG_EXPERIENCE is universally understood."""
         sock = self._connect()
-        self._negotiated = "raw"
+        # only send_experience calls this, with _send_lock held
+        self._negotiated = "raw"  # apexlint: unguarded(caller holds _send_lock)
         if self._codec != "raw":
             try:
                 _send_msg(sock, MSG_HELLO,
@@ -900,7 +908,7 @@ class SocketTransport:
                 if msg is not None and msg[0] == MSG_HELLO_ACK:
                     grant = json.loads(bytes(msg[1])).get("codec")
                     if grant in WIRE_CODECS:
-                        self._negotiated = grant
+                        self._negotiated = grant  # apexlint: unguarded(caller holds _send_lock)
             except (OSError, ValueError):
                 pass  # old server / timeout / garbage ack -> raw
             finally:
@@ -971,7 +979,10 @@ class SocketTransport:
                 self._param_sock = None
                 return None, -1
         try:
-            self._bytes_in += len(msg[1])
+            # the blob decode below deliberately runs outside
+            # _param_lock; re-take it for the counter bump alone
+            with self._param_lock:
+                self._bytes_in += len(msg[1])
             params, version = pickle.loads(msg[1])
             return _upcast_bf16(params), version
         except Exception as e:

@@ -15,7 +15,9 @@ defers its jax import) so the report CLI stays cheap to start.
 from ape_x_dqn_tpu.obs.core import (
     NULL_OBS, NullObs, Obs, SampleAgeTracker, build_obs)
 from ape_x_dqn_tpu.obs.health import (
-    HeartbeatRegistry, HeartbeatWatchdog, StallError, StallWatchdog)
+    HeartbeatRegistry, HeartbeatWatchdog, LockOrderError,
+    LockOrderRecorder, StallError, StallWatchdog, WitnessLock,
+    make_lock)
 from ape_x_dqn_tpu.obs.registry import (
     Counter, Gauge, Histogram, MetricRegistry, geometric_edges)
 from ape_x_dqn_tpu.obs.trace import (
@@ -23,8 +25,9 @@ from ape_x_dqn_tpu.obs.trace import (
 
 __all__ = [
     "NULL_OBS", "NULL_TRACER", "Counter", "Gauge", "HeartbeatRegistry",
-    "HeartbeatWatchdog", "Histogram", "MetricRegistry", "NullObs",
-    "NullTracer", "Obs", "SampleAgeTracker", "SpanTracer", "StallError",
-    "StallWatchdog", "build_obs", "geometric_edges", "load_trace",
-    "span_names",
+    "HeartbeatWatchdog", "Histogram", "LockOrderError",
+    "LockOrderRecorder", "MetricRegistry", "NullObs", "NullTracer",
+    "Obs", "SampleAgeTracker", "SpanTracer", "StallError",
+    "StallWatchdog", "WitnessLock", "build_obs", "geometric_edges",
+    "load_trace", "make_lock", "span_names",
 ]

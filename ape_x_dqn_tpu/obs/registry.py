@@ -26,6 +26,8 @@ from typing import Any, Iterable
 
 import numpy as np
 
+from ape_x_dqn_tpu.obs.health import make_lock
+
 
 def geometric_edges(lo: float = 1.0, hi: float = 1e6,
                     per_decade: int = 4) -> tuple[float, ...]:
@@ -43,8 +45,8 @@ class Counter:
 
     def __init__(self, name: str):
         self.name = name
-        self._v = 0.0
-        self._lock = threading.Lock()
+        self._v = 0.0  # guarded-by: _lock
+        self._lock = make_lock("registry.instrument")
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
@@ -63,8 +65,8 @@ class Gauge:
 
     def __init__(self, name: str):
         self.name = name
-        self._v = 0.0
-        self._lock = threading.Lock()
+        self._v = 0.0  # guarded-by: _lock
+        self._lock = make_lock("registry.instrument")
 
     def set(self, v: float) -> None:
         with self._lock:
@@ -90,12 +92,12 @@ class Histogram:
         assert self._edges == tuple(sorted(self._edges)) and self._edges, \
             f"histogram {name!r} needs ascending, non-empty edges"
         self._edges_np = np.asarray(self._edges, np.float64)
-        self._counts = np.zeros(len(self._edges) + 1, np.int64)
-        self._count = 0
-        self._sum = 0.0
-        self._min = float("inf")
-        self._max = float("-inf")
-        self._lock = threading.Lock()
+        self._counts = np.zeros(len(self._edges) + 1, np.int64)  # guarded-by: _lock
+        self._count = 0  # guarded-by: _lock
+        self._sum = 0.0  # guarded-by: _lock
+        self._min = float("inf")  # guarded-by: _lock
+        self._max = float("-inf")  # guarded-by: _lock
+        self._lock = make_lock("registry.instrument")
 
     def observe(self, v: float) -> None:
         v = float(v)
@@ -174,10 +176,10 @@ class MetricRegistry:
     """Get-or-create instrument registry + one-record JSONL publish."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: dict[str, Counter] = {}
-        self._gauges: dict[str, Gauge] = {}
-        self._hists: dict[str, Histogram] = {}
+        self._lock = make_lock("registry.tables")
+        self._counters: dict[str, Counter] = {}  # guarded-by: _lock
+        self._gauges: dict[str, Gauge] = {}  # guarded-by: _lock
+        self._hists: dict[str, Histogram] = {}  # guarded-by: _lock
 
     def counter(self, name: str) -> Counter:
         with self._lock:

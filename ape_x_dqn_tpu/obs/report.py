@@ -23,18 +23,43 @@ import json
 import sys
 from typing import Any
 
-# healthy ranges printed next to the staleness percentiles (and
-# documented in PERF.md "Observability"): Ape-X tolerates replay
+# The canonical instrument table: one row per metric name the runtime
+# can emit, keyed by JSONL name with the registry's kind prefix
+# (hist/ gauge/ ctr/). apexlint's obs-names checker cross-references
+# this table against every emission site in the package, both ways —
+# an emitted name missing here, or a row no code emits, is a lint
+# failure — so the report can never silently drop a signal a PR adds.
+# "warn" rows carry the healthy-range rule printed next to the value
+# (and documented in PERF.md "Observability"): Ape-X tolerates replay
 # staleness by design, but tails beyond these suggest the learner is
-# overrunning ingest (age) or the publish path is wedged (lag)
-HEALTHY = {
-    "sample_age_steps": ("p99", 200_000,
-                         "p99 sampled age beyond ~capacity suggests the "
-                         "learner free-runs over stale replay"),
-    "param_lag_steps": ("p99", 1_000,
-                        "p99 actor param lag should stay within a few "
-                        "publish_every periods"),
+# overrunning ingest (age) or the publish path is wedged (lag).
+INSTRUMENTS = {
+    "sample_age_steps": {
+        "kind": "hist",
+        "warn": ("p99", 200_000,
+                 "p99 sampled age beyond ~capacity suggests the "
+                 "learner free-runs over stale replay")},
+    "param_lag_steps": {
+        "kind": "hist",
+        "warn": ("p99", 1_000,
+                 "p99 actor param lag should stay within a few "
+                 "publish_every periods")},
+    "td_abs": {"kind": "hist"},
+    "server_batch_items": {"kind": "hist"},
+    "ingest_staging_occupancy": {"kind": "gauge"},
+    "ingest_coalesce_width": {"kind": "gauge"},
+    "ingest_decode_ms": {"kind": "gauge"},
+    "wire_compression_ratio": {"kind": "gauge"},
+    "replay_occupancy": {"kind": "gauge"},
+    "server_queue_depth": {"kind": "gauge"},
+    "stall_errors": {"kind": "ctr"},
+    "replay_adds": {"kind": "ctr"},
 }
+
+# healthy ranges, derived view kept under its historical name (the
+# formatting path and PERF.md both refer to HEALTHY)
+HEALTHY = {name: row["warn"] for name, row in INSTRUMENTS.items()
+           if "warn" in row}
 
 
 def load_records(path: str) -> list[dict]:

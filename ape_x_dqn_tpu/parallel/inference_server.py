@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ape_x_dqn_tpu.obs.core import NULL_OBS
+from ape_x_dqn_tpu.obs.health import make_lock
 from ape_x_dqn_tpu.utils.misc import next_pow2
 
 
@@ -88,8 +89,8 @@ class BatchedInferenceServer:
             self._apply = jax.jit(apply_fn)
             self._batched_sharding = None
             self._min_bucket = 1
-        self._params = params
-        self._params_version = 0
+        self._params = params  # guarded-by: _lock
+        self._params_version = 0  # guarded-by: _lock
         self._max_batch = max_batch
         self._deadline_s = deadline_ms / 1000.0
         self._q: queue.Queue[_Request] = queue.Queue()
@@ -97,9 +98,12 @@ class BatchedInferenceServer:
         # held for the next batch — only the serve thread touches it
         self._held: _Request | None = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
-        self._batches_served = 0
-        self._items_served = 0
+        # _lock guards the published params (swapped by the driver's
+        # ingest thread, read by the serve thread) and the served-stat
+        # counters (bumped by the serve thread, read by stats callers)
+        self._lock = make_lock("inference_server._lock")
+        self._batches_served = 0  # guarded-by: _lock
+        self._items_served = 0  # guarded-by: _lock
         self._obs = obs if obs is not None else NULL_OBS
         self._obs.register("inference-server")
         self._thread = threading.Thread(target=self._serve_loop,
@@ -298,8 +302,11 @@ class BatchedInferenceServer:
                 r.result = jax.tree.map(lambda x: x[idx], out_np)
             off += r.items
             r.event.set()
-        self._batches_served += 1
-        self._items_served += n
+        # stats() reads these from other threads; the serve thread is
+        # the only writer but += is still a read-modify-write
+        with self._lock:
+            self._batches_served += 1
+            self._items_served += n
         self._obs.on_server_batch(n, version, self._q.qsize())
 
 

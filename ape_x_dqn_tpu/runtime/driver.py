@@ -31,6 +31,7 @@ from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.obs.core import build_obs
+from ape_x_dqn_tpu.obs.health import make_lock
 from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
@@ -130,7 +131,7 @@ class ApexDriver:
             else:
                 self.learner = DistDQNLearner(self.net.apply, self.replay,
                                               cfg.learner, self.mesh)
-            self.state = self.learner.init(
+            self.state = self.learner.init(  # guarded-by: _state_lock
                 params, item_spec, component_key(cfg.seed, "learner"))
             self.capacity = shard_cap * self.dp
             # publish_params already returns an independent replicated
@@ -182,21 +183,25 @@ class ApexDriver:
         # get_params); both sides only read these buffers
         self.transport.publish_params(server_params, 0)
         self.stop_event = threading.Event()
-        self.episode_returns: deque[float] = deque(maxlen=200)
+        # shared-counter lock (actor/ingest/learner/eval threads all
+        # stamp progress here); _state_lock serializes train-state
+        # swaps against checkpoint writes. Writes to the annotated
+        # attributes outside `with self.<lock>:` are apexlint failures.
+        self._lock = make_lock("driver._lock")
+        self._state_lock = make_lock("driver._state_lock")
+        self.episode_returns: deque[float] = deque(maxlen=200)  # guarded-by: _lock
         self.frames = Throughput(window_s=30.0)
         self.grad_steps = Throughput(window_s=30.0)
-        self._frames_total = 0
+        self._frames_total = 0  # guarded-by: _lock
         self._grad_steps_total = 0
-        self._lock = threading.Lock()
-        self._state_lock = threading.Lock()
-        self.actor_errors: list[tuple[int, Exception]] = []
-        self.actor_restarts: list[tuple[int, str]] = []  # recovered crashes
-        self.loop_errors: list[tuple[str, Exception]] = []  # ingest/learner
-        self._ingested_batches = 0
+        self.actor_errors: list[tuple[int, Exception]] = []  # guarded-by: _lock
+        self.actor_restarts: list[tuple[int, str]] = []  # guarded-by: _lock
+        self.loop_errors: list[tuple[str, Exception]] = []  # guarded-by: _lock
+        self._ingested_batches = 0  # guarded-by: _lock
         # host-side mirror of replay fill so the learner hot loop never
         # blocks on a device->host read of state.replay.size (round-1
         # verdict "weak" #4: that sync serialized every iteration)
-        self._replay_filled = 0
+        self._replay_filled = 0  # guarded-by: _lock
         # ingest staging: staging units accumulate host-side until a full
         # fixed-size block ships to the device in one add — [dp, chunk]
         # on the mesh, [chunk] single-chip. Fixed block shapes matter:
@@ -230,7 +235,7 @@ class ApexDriver:
         # None = finished/disabled (single capture per run)
         self._profiling: bool | None = False if cfg.profile_dir else None
         self._profile_from = 0
-        self.last_eval: dict | None = None
+        self.last_eval: dict | None = None  # guarded-by: _lock
         # checkpoint/resume (SURVEY.md §5): params/targets/opt/rng/step
         # always; replay contents too when cfg.checkpoint_replay (off by
         # default — large, and Ape-X tolerates refilling; opt in to skip
@@ -986,7 +991,11 @@ class ApexDriver:
                         max_frames=self.cfg.eval_max_frames,
                         deadline_s=self.cfg.final_eval_deadline_s)
                     if res is not None:
-                        self.last_eval = res
+                        # the periodic eval thread's join above is
+                        # timeout-bounded: it can still be mid-write
+                        # when this teardown eval lands
+                        with self._lock:
+                            self.last_eval = res
                         self.metrics.log(self._grad_steps_total,
                                          avg_eval_return=res["mean_return"],
                                          eval_episodes=res["episodes"],

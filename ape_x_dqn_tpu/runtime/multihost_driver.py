@@ -56,7 +56,7 @@ from ape_x_dqn_tpu.configs import RunConfig
 # single-host heartbeat watchdog and this lockstep watchdog live
 # together; re-exported here because tests and operational docs import
 # it from this module.
-from ape_x_dqn_tpu.obs.health import StallWatchdog  # noqa: F401
+from ape_x_dqn_tpu.obs.health import StallWatchdog, make_lock  # noqa: F401
 from ape_x_dqn_tpu.obs.core import build_obs
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
@@ -217,8 +217,8 @@ class MultihostApexDriver:
         self.transport.publish_params(server_params, 0)
 
         self.stop_event = threading.Event()
-        self.episode_returns: deque[float] = deque(maxlen=200)
-        self._frames_local = 0
+        self.episode_returns: deque[float] = deque(maxlen=200)  # guarded-by: _lock
+        self._frames_local = 0  # guarded-by: _lock
         # frame counters survive resume: _frames_base restores from the
         # checkpoint so a --total-env-frames budget CONTINUES after a
         # preemption instead of re-running in full (round-2 advisor
@@ -260,10 +260,10 @@ class MultihostApexDriver:
         self._stage_n = 0
         self._actor_threads: list[threading.Thread] = []
         self._saw_remote = False  # first remote actor-host connection
-        self._lock = threading.Lock()
-        self.actor_errors: list[tuple[int, Exception]] = []
-        self.last_eval: dict | None = None
-        self._eval_error: Exception | None = None
+        self._lock = make_lock("multihost_driver._lock")
+        self.actor_errors: list[tuple[int, Exception]] = []  # guarded-by: _lock
+        self.last_eval: dict | None = None  # guarded-by: _lock
+        self._eval_error: Exception | None = None  # guarded-by: _lock
 
     # -- checkpoint/resume -------------------------------------------------
 
@@ -817,14 +817,19 @@ class MultihostApexDriver:
                     max_frames=cfg.eval_max_frames,
                     deadline_s=cfg.final_eval_deadline_s)
                 if res is not None:
-                    self.last_eval = res
+                    # the periodic eval thread's join above is
+                    # timeout-bounded: it can still be mid-write when
+                    # this teardown eval lands
+                    with self._lock:
+                        self.last_eval = res
                     self.metrics.log(
                         self._grad_steps,
                         avg_eval_return=res["mean_return"],
                         eval_episodes=res["episodes"],
                         eval_game=game or cfg.env.id)
             except Exception as e:  # noqa: BLE001
-                self._eval_error = e
+                with self._lock:
+                    self._eval_error = e
         self.server.stop()
         self.obs.close(self._grad_steps)
         with self._lock:

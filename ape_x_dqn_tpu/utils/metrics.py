@@ -17,15 +17,17 @@ import time
 from collections import deque
 from typing import Any, IO
 
+from ape_x_dqn_tpu.obs.health import make_lock
+
 
 class Throughput:
     """Windowed throughput counter (events/sec over a sliding window)."""
 
     def __init__(self, window_s: float = 10.0):
         self._window = window_s
-        self._events: deque[tuple[float, float]] = deque()
-        self._total = 0.0
-        self._lock = threading.Lock()
+        self._events: deque[tuple[float, float]] = deque()  # guarded-by: _lock
+        self._total = 0.0  # guarded-by: _lock
+        self._lock = make_lock("metrics.throughput")
 
     def add(self, n: float = 1.0, now: float | None = None) -> None:
         now = time.monotonic() if now is None else now
@@ -68,8 +70,8 @@ class Metrics:
 
     def __init__(self, log_path: str | None = None,
                  tensorboard_dir: str | None = None):
-        self._latest: dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._latest: dict[str, Any] = {}  # guarded-by: _lock
+        self._lock = make_lock("metrics.sink")
         self._fh: IO[str] | None = None
         if log_path:
             os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)

@@ -8,6 +8,26 @@ concurrency-sensitive scaffolding once — per-pid temp + atomic rename
 (concurrent first use across processes must not cache a corrupt .so),
 temp cleanup on failed/timed-out compiles, mtime staleness, one-shot
 caching — so the per-component bindings don't each re-implement it.
+
+Every build runs with -Wall -Wextra -Werror: the native modules are
+small enough that zero-warning is cheap to hold, and a warning in a
+memcpy/pointer-arithmetic data plane is usually a bug report.
+
+APEX_NATIVE_SANITIZE=1 additionally compiles with
+-fsanitize=address,undefined for local debugging runs. Sanitized
+builds land in a separate `<name>.san.so` artifact so they can never
+poison the normal build cache. Loading one into a non-ASan python
+needs the process launched with the runtime preloaded (or the ASan
+link-order check relaxed), e.g.:
+
+    LD_PRELOAD=$(gcc -print-file-name=libasan.so) \
+        APEX_NATIVE_SANITIZE=1 python ...
+    # or: ASAN_OPTIONS=verify_asan_link_order=0 APEX_NATIVE_SANITIZE=1 ...
+
+When neither is set the sanitized .so is still BUILT (so the compile
+gate runs) but not loaded — the callers fall back to the pure-Python
+paths with a one-line stderr warning instead of ASan aborting the
+process at dlopen.
 """
 
 from __future__ import annotations
@@ -20,7 +40,15 @@ import subprocess
 import threading
 
 _lock = threading.Lock()
-_cache: dict[str, ctypes.CDLL | None] = {}
+_cache: dict[str, ctypes.CDLL | None] = {}  # guarded-by: _lock
+
+# the data plane must stay warning-clean; -Werror keeps it honest
+WARNING_FLAGS = ("-Wall", "-Wextra", "-Werror")
+SANITIZE_FLAGS = ("-fsanitize=address,undefined", "-fno-omit-frame-pointer")
+
+
+def _sanitize() -> bool:
+    return os.environ.get("APEX_NATIVE_SANITIZE", "") not in ("", "0")
 
 
 def build_and_load(src: str, so: str,
@@ -31,6 +59,35 @@ def build_and_load(src: str, so: str,
     callers fall back to their pure-Python implementations. The result
     (including None) is cached per so-path for the process lifetime.
     """
+    extra: tuple[str, ...] = WARNING_FLAGS
+    load_ok = True
+    if _sanitize():
+        # distinct artifact name: a sanitized .so must never be picked
+        # up by a later non-sanitized run's mtime check (or vice versa)
+        root, ext = os.path.splitext(so)
+        so = f"{root}.san{ext}"
+        extra = extra + SANITIZE_FLAGS
+        # dlopen'ing an ASan .so into a python that wasn't started with
+        # the runtime preloaded (or the link-order check relaxed) makes
+        # the ASan init ABORT the whole process — and it snapshots the
+        # environment before python code runs, so this cannot be fixed
+        # from here. Build the artifact (so -Werror + sanitizer compile
+        # checks still gate), but only load it when the process was
+        # launched prepared; otherwise warn once and fall back.
+        load_ok = (
+            "asan" in os.environ.get("LD_PRELOAD", "")
+            or "verify_asan_link_order=0" in os.environ.get(
+                "ASAN_OPTIONS", ""))
+        if not load_ok:
+            import sys
+            print(
+                "[native-build] APEX_NATIVE_SANITIZE=1 but the ASan "
+                "runtime is not loadable in this process; built "
+                f"{os.path.basename(so)} but using the Python "
+                "fallback. Relaunch with LD_PRELOAD=$(gcc "
+                "-print-file-name=libasan.so) or "
+                "ASAN_OPTIONS=verify_asan_link_order=0.",
+                file=sys.stderr)
     with _lock:
         if so in _cache:
             return _cache[so]
@@ -40,11 +97,11 @@ def build_and_load(src: str, so: str,
             if (not os.path.exists(so)
                     or os.path.getmtime(so) < os.path.getmtime(src)):
                 subprocess.run(
-                    ["g++", "-O3", *flags, "-shared", "-fPIC",
+                    ["g++", "-O3", *extra, *flags, "-shared", "-fPIC",
                      src, "-o", tmp],
                     check=True, capture_output=True, timeout=120)
                 os.replace(tmp, so)
-            lib = ctypes.CDLL(so)
+            lib = ctypes.CDLL(so) if load_ok else None
         except (OSError, subprocess.SubprocessError):
             lib = None
         finally:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -1062,6 +1063,16 @@ def main() -> None:
             ab["env_frames_per_s"], 1)
         secondary["actor_server_avg_batch"] = round(
             ab["server_avg_batch"], 2)
+
+    try:
+        from tools.apexlint import run as apexlint_run
+        lint = apexlint_run(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "ape_x_dqn_tpu"))
+        secondary["apexlint"] = {"findings": len(lint["findings"]),
+                                 "waivers": lint["waivers"]}
+    except Exception as e:  # lint must never sink a bench run
+        secondary["apexlint"] = {"error": repr(e)}
 
     baseline = 19.0  # Horgan et al. 2018: 1-GPU learner, batch 512
     print(json.dumps({

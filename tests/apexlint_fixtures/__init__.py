@@ -1,0 +1,5 @@
+"""Deliberately good/bad snippets exercising each apexlint checker.
+
+These are parsed by the checkers, never imported or executed — the
+`bad_*` modules contain real concurrency/jit bugs on purpose.
+"""

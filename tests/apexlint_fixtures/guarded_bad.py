@@ -1,0 +1,25 @@
+"""guarded-by fixture: exactly one unguarded write to an annotated
+attribute (`_count` in `racy_bump`)."""
+
+import threading
+
+
+class Bad:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded-by: _lock
+
+    def safe_bump(self):
+        with self._lock:
+            self._count += 1
+
+    def racy_bump(self):
+        self._count += 1  # the finding: += outside `with self._lock:`
+
+    def closure_is_not_covered(self):
+        with self._lock:
+            def later():
+                # runs after the with-block exits: must NOT count as
+                # locked (but it is waived here, so not a finding)
+                self._count = 0  # apexlint: unguarded(fixture: lexical-scope demo)
+            return later

@@ -1,0 +1,23 @@
+"""jit-purity fixture: exactly one host effect reachable from a jit
+boundary — `time.time()` two hops down from the jitted entrypoint."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return _loss(state, batch)
+
+
+def _loss(state, batch):
+    return _timed_residual(state, batch)
+
+
+def _timed_residual(state, batch):
+    t0 = time.time()  # the finding: host clock inside traced code
+    del t0
+    return jnp.mean((state - batch) ** 2)

@@ -1,0 +1,36 @@
+"""jit-purity fixture: pure jitted chain + host effects that are NOT
+reachable from any jit boundary (and one waived trace-time effect)."""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnums=(1,))
+def step(x, n):
+    return _helper(x) * n
+
+
+def _helper(x):
+    return jnp.tanh(x)
+
+
+def host_loop(metrics, x):
+    # never jitted: host effects are fine here
+    t0 = time.time()
+    y = np.asarray(step(x, 2))
+    print("host loop", time.time() - t0)
+    return y
+
+
+@jax.jit
+def traced_with_waiver(x):
+    # deliberate trace-time effect, justified:
+    print("tracing step")  # apexlint: host-effect(fixture: trace-time log)
+    return x + 1
+
+
+scale = jax.jit(lambda x: x * 2.0)

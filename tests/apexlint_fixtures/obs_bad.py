@@ -1,0 +1,6 @@
+"""obs-names fixture: one emission with no table row and no waiver."""
+
+
+def publish(obs, value):
+    obs.observe("listed_hist", value)
+    obs.count("rogue_counter")  # the finding: not in INSTRUMENTS

@@ -1,0 +1,7 @@
+"""obs-names fixture: every emission has a table row (or a waiver)."""
+
+
+def publish(obs, value):
+    obs.observe("listed_hist", value)
+    obs.gauge("listed_gauge", value)
+    obs.gauge("scratch_gauge", value)  # apexlint: unlisted(fixture: debug-only)

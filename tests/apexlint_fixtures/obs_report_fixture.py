@@ -1,0 +1,13 @@
+"""obs-names fixture: a mini report-side INSTRUMENTS table.
+
+`listed_hist` and `listed_gauge` are emitted by the good/bad emitter
+fixtures; `dead_row` is listed but emitted nowhere (the finding);
+`external_row` is also unemitted but carries a justified waiver.
+"""
+
+INSTRUMENTS = {
+    "listed_hist": {"kind": "hist"},
+    "listed_gauge": {"kind": "gauge"},
+    "dead_row": {"kind": "ctr"},
+    "external_row": {"kind": "gauge"},  # apexlint: unemitted(fixture: emitted by an external probe)
+}

@@ -1,0 +1,29 @@
+"""wire-protocol fixture: MSG_PONG is half-wired — the server never
+references it, with no waiver. Exactly one finding."""
+
+MSG_DATA = 1
+MSG_PING = 2
+MSG_PONG = 3
+MSG_ERR = 4
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_DATA:
+            return payload
+        if mtype == MSG_PING:
+            return MSG_ERR  # replies with the wrong type: PONG unwired
+        return None
+
+
+class Client:
+    def roundtrip(self, sock):
+        sock.send(MSG_PING)
+        kind = sock.recv()
+        if kind == MSG_PONG:
+            return True
+        if kind == MSG_DATA:
+            return False
+        if kind == MSG_ERR:
+            raise RuntimeError("peer error")
+        return None

@@ -15,6 +15,13 @@ import os
 faulthandler.enable()
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# every runtime lock is built via obs.health.make_lock; under this
+# flag they become witness locks that record the lock-acquisition
+# graph and raise LockOrderError the moment any test's code path
+# acquires two locks in an order that closes a cycle — a deadlock
+# that would otherwise need a precise interleave to reproduce
+os.environ.setdefault("APEX_LOCK_WITNESS", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -20,6 +20,15 @@ cd "$(dirname "$0")/.."
 
 fail=0
 failed_files=()
+
+# Static-analysis gate first: cheap (stdlib-only, no jax import) and a
+# finding here usually explains the test failure that would follow.
+echo "=== tools/apexlint"
+if ! python -m tools.apexlint ape_x_dqn_tpu/ --format=json; then
+    fail=1
+    failed_files+=("tools/apexlint")
+fi
+echo
 for f in tests/test_*.py; do
     echo "=== ${f}"
     if ! env JAX_PLATFORMS=cpu python -m pytest "${f}" -q -m 'not slow' \

@@ -1,0 +1,65 @@
+"""apexlint: project-native static analysis for the Ape-X runtime.
+
+Four stdlib-only AST checkers over the package source (no imports of
+the code under analysis, no third-party deps):
+
+- guarded-by   lock discipline for `# guarded-by: <lock>` attributes
+- jit-purity   no host effects reachable from jax.jit boundaries
+- wire-protocol every MSG_* handled in every dispatch chain
+- obs-names    emitted instruments <-> obs/report.py table, both ways
+
+CLI: `python -m tools.apexlint ape_x_dqn_tpu/ [--format=json]`
+exits 0 only with zero unwaived findings; tests/test_apexlint.py runs
+it over the package as a tier-1 gate. The dynamic companion (the
+lock-order witness) lives in ape_x_dqn_tpu/obs/health.py, enabled
+under APEX_LOCK_WITNESS=1 by tests/conftest.py.
+"""
+
+from __future__ import annotations
+
+import os
+
+from tools.apexlint import (
+    guarded_by, jit_purity, obs_names, wire_protocol)
+from tools.apexlint.common import CheckResult, Finding, ModuleSource
+
+__all__ = ["CheckResult", "Finding", "ModuleSource", "run",
+           "package_files"]
+
+
+def package_files(package_dir: str) -> list[str]:
+    out: list[str] = []
+    for root, dirs, files in os.walk(package_dir):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(files):
+            if name.endswith(".py"):
+                out.append(os.path.join(root, name))
+    return out
+
+
+def run(package_dir: str,
+        report_path: str | None = None) -> dict:
+    """Run all checkers over a package tree; returns the JSON-shaped
+    summary the CLI, tests, and bench.py all consume."""
+    paths = package_files(package_dir)
+    total = CheckResult()
+    per_checker: dict[str, int] = {}
+
+    def fold(name: str, res: CheckResult) -> None:
+        per_checker[name] = len(res.findings)
+        total.merge(res)
+
+    fold("guarded-by", guarded_by.check_paths(paths))
+    fold("jit-purity", jit_purity.check_paths(paths))
+    fold("wire-protocol", wire_protocol.check_paths(paths))
+    if report_path is None:
+        candidate = os.path.join(package_dir, "obs", "report.py")
+        report_path = candidate if os.path.exists(candidate) else None
+    if report_path is not None:
+        fold("obs-names", obs_names.check(paths, report_path))
+    return {
+        "findings": [f.as_dict() for f in total.findings],
+        "waivers": total.waivers,
+        "per_checker": per_checker,
+        "checked_files": len(paths),
+    }

@@ -1,0 +1,38 @@
+"""CLI: `python -m tools.apexlint <package_dir> [--format=json]`."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.apexlint import run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.apexlint",
+        description="Ape-X project lint: guarded-by, jit-purity, "
+                    "wire-protocol, obs-names.")
+    ap.add_argument("package", help="package directory to scan "
+                                    "(e.g. ape_x_dqn_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    args = ap.parse_args(argv)
+    summary = run(args.package)
+    if args.format == "json":
+        print(json.dumps(summary))
+    else:
+        for f in summary["findings"]:
+            print(f"{f['path']}:{f['line']}: [{f['checker']}] "
+                  f"{f['message']}")
+        counts = ", ".join(f"{k}={v}" for k, v in
+                           sorted(summary["per_checker"].items()))
+        print(f"apexlint: {len(summary['findings'])} finding(s), "
+              f"{summary['waivers']} waiver(s) across "
+              f"{summary['checked_files']} files ({counts})")
+    return 1 if summary["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

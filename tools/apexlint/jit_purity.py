@@ -1,0 +1,218 @@
+"""Jit-purity checker: no host effects reachable from a jit boundary.
+
+A function is a *jit root* when it is decorated `@jax.jit` /
+`@partial(jax.jit, ...)`, or passed to `jax.jit(fn)` as a module-local
+function, same-class method (`jax.jit(self._step)`), or inline lambda.
+From each root the checker walks the module-local call graph (calls to
+module-level functions and to `self.<method>` within the same class)
+and flags host-effect calls anywhere in the reachable bodies:
+
+- wall-clock reads / sleeps (`time.time`, `time.monotonic`, ...)
+- `print(...)` (use `jax.debug.print` inside traced code)
+- `.item()` — a blocking device->host transfer that also leaks tracers
+- `np.asarray` / `np.array` / `np.frombuffer` on traced values
+- metric/trace emission (`obs.observe/gauge/count/...`,
+  `metrics.log/write`, `trace.span`) — host I/O that silently turns
+  into a tracer leak or a retrace
+
+Inside jit these either fail loudly (tracer leak), or worse, succeed
+once at trace time and then never run again — a metric that reports
+the compile-time value forever. Waive a deliberate trace-time effect
+with `# apexlint: host-effect(<why>)`.
+
+The call graph is module-local by design: cross-module helpers called
+from jit are checked when their own module is scanned (every module
+with a jit callsite is in the scan set).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.apexlint.common import (
+    CheckResult, Finding, ModuleSource, dotted_name)
+
+CHECKER = "jit-purity"
+
+TIME_EFFECTS = {"time", "monotonic", "perf_counter", "perf_counter_ns",
+                "time_ns", "sleep"}
+NUMPY_EFFECTS = {"asarray", "array", "frombuffer", "copyto", "save"}
+# emission methods flagged only on obs/metrics/registry-ish receivers,
+# so `q.count(x)` on a plain container does not false-positive
+EMIT_METHODS = {"observe", "observe_many", "gauge", "count",
+                "counter", "histogram", "span", "publish", "log",
+                "write", "beat"}
+EMIT_RECEIVERS = {"obs", "obs_", "_obs", "metrics", "_metrics",
+                  "registry", "_reg", "_registry", "tracer", "_tracer",
+                  "trace", "heartbeat", "_heartbeats"}
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return dotted_name(node) in ("jax.jit", "jit")
+
+
+def _jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func):
+                return True
+            # @partial(jax.jit, ...)
+            if (dotted_name(dec.func) in ("partial", "functools.partial")
+                    and dec.args and _is_jax_jit(dec.args[0])):
+                return True
+    return False
+
+
+class _ModuleIndex:
+    """Module-level functions and per-class methods, by name."""
+
+    def __init__(self, tree: ast.Module):
+        self.functions: dict[str, ast.FunctionDef] = {}
+        self.methods: dict[str, dict[str, ast.FunctionDef]] = {}
+        self.owner: dict[int, str | None] = {}  # id(fn-node) -> class
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+                self.owner[id(node)] = None
+            elif isinstance(node, ast.ClassDef):
+                table: dict[str, ast.FunctionDef] = {}
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        table[item.name] = item
+                        self.owner[id(item)] = node.name
+                self.methods[node.name] = table
+
+    def resolve(self, call: ast.Call,
+                cls: str | None) -> ast.FunctionDef | None:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.functions.get(func.id)
+        if (cls is not None and isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "self"):
+            return self.methods.get(cls, {}).get(func.attr)
+        return None
+
+
+def _jit_roots(index: _ModuleIndex,
+               tree: ast.Module) -> list[tuple[ast.AST, str | None]]:
+    """(function-or-lambda node, owning-class) for every jit boundary."""
+    roots: list[tuple[ast.AST, str | None]] = []
+    for name, fn in index.functions.items():
+        if _jit_decorated(fn):
+            roots.append((fn, None))
+    for cls, table in index.methods.items():
+        for name, fn in table.items():
+            if _jit_decorated(fn):
+                roots.append((fn, cls))
+
+    # jax.jit(<arg>) callsites anywhere in the module
+    def walk(node: ast.AST, cls: str | None) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                walk(child, node.name)
+            return
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            if node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    target = index.functions.get(arg.id)
+                    if target is not None:
+                        roots.append((target, None))
+                elif isinstance(arg, ast.Lambda):
+                    roots.append((arg, cls))
+                elif (isinstance(arg, ast.Attribute)
+                      and isinstance(arg.value, ast.Name)
+                      and arg.value.id == "self" and cls is not None):
+                    target = index.methods.get(cls, {}).get(arg.attr)
+                    if target is not None:
+                        roots.append((target, cls))
+        for child in ast.iter_child_nodes(node):
+            walk(child, cls)
+
+    walk(tree, None)
+    return roots
+
+
+def _reachable(index: _ModuleIndex,
+               roots: list[tuple[ast.AST, str | None]]
+               ) -> list[tuple[ast.AST, str | None]]:
+    seen: set[int] = set()
+    out: list[tuple[ast.AST, str | None]] = []
+    work = list(roots)
+    while work:
+        fn, cls = work.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        out.append((fn, cls))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                target = index.resolve(node, cls)
+                if target is not None and id(target) not in seen:
+                    work.append((target, index.owner.get(id(target))))
+    return out
+
+
+def _host_effect(call: ast.Call) -> str | None:
+    """Describe the host effect of a call, or None when pure."""
+    func = call.func
+    if isinstance(func, ast.Name) and func.id == "print":
+        return "print() (use jax.debug.print in traced code)"
+    if not isinstance(func, ast.Attribute):
+        return None
+    name = dotted_name(func)
+    if name is not None:
+        head, _, attr = name.rpartition(".")
+        if head == "time" and attr in TIME_EFFECTS:
+            return f"time.{attr}() reads the host clock"
+        if head in ("np", "numpy") and attr in NUMPY_EFFECTS:
+            return (f"{head}.{attr}() forces a host round-trip on a "
+                    f"traced value")
+    if func.attr == "item" and not call.args and not call.keywords:
+        return ".item() blocks on a device->host transfer"
+    if func.attr in EMIT_METHODS:
+        recv = func.value
+        last = None
+        if isinstance(recv, ast.Name):
+            last = recv.id
+        elif isinstance(recv, ast.Attribute):
+            last = recv.attr
+        if last in EMIT_RECEIVERS:
+            return (f"metric/trace emission .{func.attr}() is host I/O "
+                    f"inside a traced function")
+    return None
+
+
+def check_module(src: ModuleSource) -> CheckResult:
+    result = CheckResult()
+    index = _ModuleIndex(src.tree)
+    roots = _jit_roots(index, src.tree)
+    seen_lines: set[int] = set()
+    for fn, _cls in _reachable(index, roots):
+        fn_name = getattr(fn, "name", "<lambda>")
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            effect = _host_effect(node)
+            if effect is None or node.lineno in seen_lines:
+                continue
+            seen_lines.add(node.lineno)
+            if src.waiver(node.lineno, "host-effect") is not None:
+                result.waivers += 1
+                continue
+            result.findings.append(Finding(
+                CHECKER, src.path, node.lineno,
+                f"{effect} — reachable from a jax.jit boundary via "
+                f"{fn_name}()"))
+    return result
+
+
+def check_paths(paths: list[str]) -> CheckResult:
+    result = CheckResult()
+    for path in paths:
+        result.merge(check_module(ModuleSource(path)))
+    return result

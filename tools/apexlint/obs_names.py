@@ -1,0 +1,133 @@
+"""Obs-name drift: emitted instruments <-> report table, both ways.
+
+Every metric name emitted through the Obs facade or the registry —
+`.observe("name", ...)`, `.observe_many`, `.gauge`, `.count`,
+`.histogram("name", edges)`, `.counter` with a string-literal first
+argument — must have a row in `obs/report.py`'s `INSTRUMENTS` table
+(which also carries the healthy-range bounds the report warns on),
+and every table row must correspond to a name the code can actually
+emit. Drift in either direction is a finding:
+
+- emitted-but-unlisted: the report silently drops the signal a PR
+  just added (waive the emission line with
+  `# apexlint: unlisted(<why>)` for deliberate scratch metrics);
+- listed-but-unemitted: a dead row that documents an instrument no
+  code path produces (waive the table row with
+  `# apexlint: unemitted(<why>)`, e.g. emitted by an external tool).
+
+A kind mismatch (emitted as a gauge, listed as a counter) is also a
+finding: the report would look for it under the wrong `gauge/`-vs-
+`ctr/` JSONL prefix and never print it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.apexlint.common import CheckResult, Finding, ModuleSource
+
+CHECKER = "obs-names"
+
+# method name -> instrument kind, as MetricRegistry.publish prefixes
+# them in the JSONL stream (ctr/ gauge/ hist/)
+EMIT_KINDS = {
+    "observe": "hist",
+    "observe_many": "hist",
+    "histogram": "hist",
+    "gauge": "gauge",
+    "count": "ctr",
+    "counter": "ctr",
+}
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def collect_emissions(paths: list[str]) -> tuple[
+        dict[str, tuple[str, str, int]], "CheckResult"]:
+    """name -> (kind, path, line) across `paths`; waived emissions are
+    counted but excluded from the cross-reference."""
+    emissions: dict[str, tuple[str, str, int]] = {}
+    result = CheckResult()
+    for path in paths:
+        src = ModuleSource(path)
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            kind = EMIT_KINDS.get(node.func.attr)
+            if kind is None or not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            name = arg.value
+            if not NAME_RE.match(name):
+                continue  # e.g. str.count(",") on a plain string
+            if src.waiver(node.lineno, "unlisted") is not None:
+                result.waivers += 1
+                continue
+            prev = emissions.get(name)
+            if prev is not None and prev[0] != kind:
+                result.findings.append(Finding(
+                    CHECKER, path, node.lineno,
+                    f"instrument {name!r} emitted as {kind} here but "
+                    f"as {prev[0]} at {prev[1]}:{prev[2]}"))
+                continue
+            emissions.setdefault(name, (kind, path, node.lineno))
+    return emissions, result
+
+
+def _table(report_src: ModuleSource) -> dict[str, tuple[str, int]]:
+    """name -> (kind, line) from the INSTRUMENTS dict literal."""
+    table: dict[str, tuple[str, int]] = {}
+    for node in report_src.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "INSTRUMENTS"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for key, value in zip(node.value.keys, node.value.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)):
+                continue
+            kind = None
+            if isinstance(value, ast.Dict):
+                for k, v in zip(value.keys, value.values):
+                    if (isinstance(k, ast.Constant)
+                            and k.value == "kind"
+                            and isinstance(v, ast.Constant)):
+                        kind = v.value
+            table[key.value] = (kind or "?", key.lineno)
+    return table
+
+
+def check(paths: list[str], report_path: str) -> CheckResult:
+    emissions, result = collect_emissions(
+        [p for p in paths if not p.endswith("obs/report.py")])
+    report_src = ModuleSource(report_path)
+    table = _table(report_src)
+    for name, (kind, path, line) in sorted(emissions.items()):
+        row = table.get(name)
+        if row is None:
+            result.findings.append(Finding(
+                CHECKER, path, line,
+                f"emitted instrument {name!r} ({kind}) has no row in "
+                f"{report_path}'s INSTRUMENTS table"))
+        elif row[0] != kind:
+            result.findings.append(Finding(
+                CHECKER, report_path, row[1],
+                f"instrument {name!r} listed as {row[0]} but emitted "
+                f"as {kind} at {path}:{line}"))
+    for name, (kind, line) in sorted(table.items()):
+        if name in emissions:
+            continue
+        if report_src.waiver(line, "unemitted") is not None:
+            result.waivers += 1
+            continue
+        result.findings.append(Finding(
+            CHECKER, report_path, line,
+            f"INSTRUMENTS row {name!r} ({kind}) is emitted nowhere "
+            f"in the scanned package"))
+    return result

@@ -1,0 +1,82 @@
+"""Wire-protocol exhaustiveness: every MSG_* handled on both ends.
+
+`comm/socket_transport.py` defines the protocol as module-level
+`MSG_*` integer constants. A "dispatch chain" is any class whose body
+references at least three distinct MSG_* names — in practice the
+ingest server's reader loop and the client transport. Each MSG_*
+constant must be referenced in *every* dispatch chain, or carry an
+explicit module-level waiver:
+
+    # apexlint: unhandled(MSG_LEGACY)
+
+so a new message type added to one end cannot ship half-wired (the
+PR-4 codec negotiation added MSG_EXPERIENCE_C to both ends by hand;
+this makes the next one a lint failure instead of a runtime stall).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.apexlint.common import CheckResult, Finding, ModuleSource
+
+CHECKER = "wire-protocol"
+
+MSG_NAME_RE = re.compile(r"^MSG_[A-Z0-9_]+$")
+DISPATCH_MIN_REFS = 3
+
+
+def _module_constants(tree: ast.Module) -> dict[str, int]:
+    consts: dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            if (isinstance(target, ast.Name)
+                    and MSG_NAME_RE.match(target.id)
+                    and isinstance(node.value, ast.Constant)):
+                consts[target.id] = node.value.value
+    return consts
+
+
+def _class_refs(cls: ast.ClassDef, names: set[str]) -> set[str]:
+    refs: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Name) and node.id in names:
+            refs.add(node.id)
+    return refs
+
+
+def check_module(src: ModuleSource) -> CheckResult:
+    result = CheckResult()
+    consts = _module_constants(src.tree)
+    if not consts:
+        return result
+    names = set(consts)
+    waived = {arg.strip() for arg in
+              src.waivers_of_kind("unhandled").values()}
+    chains = []
+    for node in src.tree.body:
+        if isinstance(node, ast.ClassDef):
+            refs = _class_refs(node, names)
+            if len(refs) >= DISPATCH_MIN_REFS:
+                chains.append((node, refs))
+    for cls, refs in chains:
+        for name in sorted(names - refs):
+            if name in waived:
+                result.waivers += 1
+                continue
+            result.findings.append(Finding(
+                CHECKER, src.path, cls.lineno,
+                f"{name} is not handled in dispatch chain "
+                f"{cls.name!r} (reference it or waive with "
+                f"`# apexlint: unhandled({name})`)"))
+    return result
+
+
+def check_paths(paths: list[str]) -> CheckResult:
+    result = CheckResult()
+    for path in paths:
+        result.merge(check_module(ModuleSource(path)))
+    return result
