@@ -48,11 +48,27 @@ reader drops unknown types) and the client falls back to raw on the ack
 timeout. Old clients never send a hello and keep sending raw
 MSG_EXPERIENCE, which every server still accepts — old<->new peers
 interoperate in both directions.
+
+TELEMETRY (MSG_TELEMETRY): per-peer obs snapshot frames — JSON objects
+carrying a peer id, heartbeat ages, counter/gauge scalars, histogram
+snapshots, and span aggregates — ride the experience socket as a
+low-rate control plane, so the learner's fleet aggregator
+(obs/fleet.py) can merge every peer's instruments into the single run
+JSONL and feed remote heartbeats to the stall watchdog. The capability
+negotiates over the same hello/ack: a new client adds "telemetry" to
+its offer (sending the hello even when its codec is raw), a new server
+echoes the grant in the ack, an old server times the hello out (the
+client then never ships frames), and an old client never offers it.
+A connection that carried at least one telemetry frame is an
+IDENTIFIED peer: its socket closing is attributed (peer_disconnects +
+a warning naming the peer + the on_disconnect hook) instead of being
+silent actor loss.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import pickle
 import queue
 import socket
@@ -74,6 +90,7 @@ MSG_PARAMS = 3
 MSG_HELLO = 4          # client codec offer (JSON), sent on connect
 MSG_HELLO_ACK = 5      # server's codec choice (JSON)
 MSG_EXPERIENCE_C = 6   # experience payload with codec-encoded leaves
+MSG_TELEMETRY = 7      # per-peer obs snapshot frame (JSON), negotiated
 
 WIRE_CODECS = ("raw", "delta-deflate")
 
@@ -561,6 +578,17 @@ class SocketIngestServer:
         self._conns: list[socket.socket] = []  # guarded-by: _conns_lock
         self._conns_lock = make_lock("ingest_server._conns_lock")
         self._idle_grace_s = idle_grace_s
+        # fleet telemetry plane: a connection that ships at least one
+        # MSG_TELEMETRY frame identifies itself as a peer; its loss is
+        # then attributed (counter + warning + hook) instead of silent
+        self._conn_peers: dict[int, str] = {}  # guarded-by: _conns_lock
+        self._telemetry_frames = 0  # guarded-by: _conns_lock
+        self._telemetry_bytes_in = 0  # guarded-by: _conns_lock
+        self._peer_disconnects = 0  # guarded-by: _conns_lock
+        # hooks the driver installs before traffic; called from reader
+        # threads, so implementations must be thread-safe
+        self.on_telemetry: Any = None  # (peer_id: str, frame: dict) -> None
+        self.on_disconnect: Any = None  # (peer_id: str) -> None
         self._last_disconnect: float | None = None  # guarded-by: _conns_lock
         self._ever_connected = False  # guarded-by: _conns_lock
         self._accept_thread = threading.Thread(
@@ -659,6 +687,24 @@ class SocketIngestServer:
     def bytes_out(self) -> int:
         """Param blob bytes served to remote actor hosts."""
         return self._bytes_out
+
+    @property
+    def telemetry_frames(self) -> int:
+        """MSG_TELEMETRY frames received from remote peers."""
+        with self._conns_lock:
+            return self._telemetry_frames
+
+    @property
+    def telemetry_bytes_in(self) -> int:
+        """Telemetry payload bytes received (control-plane budget)."""
+        with self._conns_lock:
+            return self._telemetry_bytes_in
+
+    @property
+    def peer_disconnects(self) -> int:
+        """Identified telemetry peers whose connection closed."""
+        with self._conns_lock:
+            return self._peer_disconnects
 
     @property
     def pending(self) -> int:
@@ -768,15 +814,40 @@ class SocketIngestServer:
                     # codec negotiation: grant the configured codec iff
                     # the client offered it; else raw. An OLD client
                     # never sends a hello and keeps raw MSG_EXPERIENCE.
+                    # Telemetry is a capability echo on the same
+                    # exchange: granted iff the client offered it (an
+                    # old client never does, so this server never
+                    # expects frames from it).
                     try:
-                        offered = json.loads(bytes(payload)).get(
-                            "codecs", [])
+                        hello = json.loads(bytes(payload))
+                        offered = hello.get("codecs", [])
+                        wants_tel = bool(hello.get("telemetry"))
                     except (ValueError, AttributeError):
-                        offered = []
+                        offered, wants_tel = [], False
                     grant = self._codec if self._codec in offered \
                         else "raw"
+                    ack: dict[str, Any] = {"codec": grant}
+                    if wants_tel:
+                        ack["telemetry"] = True
                     _send_msg(conn, MSG_HELLO_ACK,
-                              json.dumps({"codec": grant}).encode())
+                              json.dumps(ack).encode())
+                elif mtype == MSG_TELEMETRY:
+                    # per-peer obs snapshot: remember which peer this
+                    # connection is (disconnect attribution), count the
+                    # frame, and hand it to the fleet aggregator hook.
+                    # A garbled frame faults this connection like any
+                    # misframed message.
+                    frame = json.loads(bytes(payload))
+                    if not isinstance(frame, dict):
+                        raise ValueError("telemetry frame is not an object")
+                    peer = str(frame.get("peer", "peer?"))
+                    with self._conns_lock:
+                        self._conn_peers[id(conn)] = peer
+                        self._telemetry_frames += 1
+                        self._telemetry_bytes_in += len(payload)
+                    cb = self.on_telemetry
+                    if cb is not None:
+                        cb(peer, frame)
                 elif mtype == MSG_PARAMS_REQ:
                     blob = self._param_blob()
                     with self._conns_lock:
@@ -791,6 +862,17 @@ class SocketIngestServer:
                 except ValueError:
                     pass
                 self._last_disconnect = time.monotonic()
+                peer = self._conn_peers.pop(id(conn), None)
+                if peer is not None:
+                    self._peer_disconnects += 1
+            if peer is not None and not self._stop.is_set():
+                # a lost actor is an attributed event, never silence
+                logging.getLogger(__name__).warning(
+                    "[fleet] telemetry peer %r disconnected — its actors "
+                    "stop producing until it reconnects", peer)
+                cb = self.on_disconnect
+                if cb is not None:
+                    cb(peer)
             try:
                 conn.close()
             except OSError:
@@ -864,12 +946,20 @@ class SocketTransport:
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
                  wire_codec: str = "delta-deflate",
-                 hello_timeout: float = 2.0):
+                 hello_timeout: float = 2.0, telemetry: bool = True):
+        """telemetry: offer the fleet-telemetry capability in the
+        connect-time hello. send_telemetry only ships frames after the
+        server granted it, so leaving this on against an old server
+        costs one hello-timeout per (re)connect and nothing after."""
         self._addr = (host, port)
         self._timeout = connect_timeout
         self._codec = _check_codec(wire_codec)
         self._hello_timeout = hello_timeout
+        self._telemetry = bool(telemetry)
         self._negotiated: str = "raw"  # guarded-by: _send_lock
+        self._telemetry_ok = False  # guarded-by: _send_lock
+        self._telemetry_frames_out = 0  # guarded-by: _send_lock
+        self._telemetry_bytes_out = 0  # guarded-by: _send_lock
         self._sock: socket.socket | None = None  # guarded-by: _send_lock
         self._param_sock: socket.socket | None = None  # guarded-by: _param_lock
         self._dropped = 0  # guarded-by: _send_lock
@@ -897,19 +987,26 @@ class SocketTransport:
         the hello, timeout, garbled ack) degrades to raw, never to an
         error — raw MSG_EXPERIENCE is universally understood."""
         sock = self._connect()
-        # only send_experience calls this, with _send_lock held
+        # only send_experience/send_telemetry call this, under _send_lock
         self._negotiated = "raw"  # apexlint: unguarded(caller holds _send_lock)
-        if self._codec != "raw":
+        self._telemetry_ok = False  # apexlint: unguarded(caller holds _send_lock)
+        if self._codec != "raw" or self._telemetry:
+            # the hello now also fires with a raw codec when telemetry
+            # is wanted — an old server still just ignores it
             try:
-                _send_msg(sock, MSG_HELLO,
-                          json.dumps({"codecs": [self._codec]}).encode())
+                offer = {"codecs": [self._codec],
+                         "telemetry": self._telemetry}
+                _send_msg(sock, MSG_HELLO, json.dumps(offer).encode())
                 sock.settimeout(self._hello_timeout)
                 msg = _recv_msg(sock)
                 if msg is not None and msg[0] == MSG_HELLO_ACK:
-                    grant = json.loads(bytes(msg[1])).get("codec")
+                    ack = json.loads(bytes(msg[1]))
+                    grant = ack.get("codec")
                     if grant in WIRE_CODECS:
                         self._negotiated = grant  # apexlint: unguarded(caller holds _send_lock)
-            except (OSError, ValueError):
+                    if self._telemetry and bool(ack.get("telemetry")):
+                        self._telemetry_ok = True  # apexlint: unguarded(caller holds _send_lock)
+            except (OSError, ValueError, AttributeError):
                 pass  # old server / timeout / garbage ack -> raw
             finally:
                 sock.settimeout(self._timeout)
@@ -948,6 +1045,32 @@ class SocketTransport:
                             pass
                     self._sock = None
             self._dropped += 1
+
+    def send_telemetry(self, frame: dict) -> bool:
+        """Best-effort ship of one obs snapshot frame (MSG_TELEMETRY,
+        JSON). Returns False — never raises into the pump thread — when
+        the server did not grant telemetry (old build), the connection
+        is down and cannot be (re)established, or the send fails; the
+        caller simply tries again at its next cadence."""
+        with self._send_lock:
+            try:
+                if self._sock is None:
+                    self._sock = self._connect_experience()
+                if not self._telemetry_ok:
+                    return False
+                payload = json.dumps(frame).encode()
+                _send_msg(self._sock, MSG_TELEMETRY, payload)
+                self._telemetry_frames_out += 1
+                self._telemetry_bytes_out += len(payload)
+                return True
+            except OSError:
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                self._sock = None
+                return False
 
     def recv_experience(self, timeout: float | None = None) -> dict | None:
         raise RuntimeError("actor-side transport cannot receive experience")
@@ -1027,6 +1150,22 @@ class SocketTransport:
         """Codec agreed with the current learner connection ("raw"
         until a hello/ack has succeeded)."""
         return self._negotiated
+
+    @property
+    def telemetry_negotiated(self) -> bool:
+        """True iff the current connection's hello/ack granted the
+        telemetry capability (always False against an old server)."""
+        return self._telemetry_ok
+
+    @property
+    def telemetry_frames_out(self) -> int:
+        """MSG_TELEMETRY frames shipped to the learner host."""
+        return self._telemetry_frames_out
+
+    @property
+    def telemetry_bytes_out(self) -> int:
+        """Telemetry payload bytes shipped (control-plane budget)."""
+        return self._telemetry_bytes_out
 
     @property
     def encode_ms(self) -> float:

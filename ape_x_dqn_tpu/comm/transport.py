@@ -16,6 +16,14 @@ collectives over NCCL (SURVEY.md §2.2 "Comm"). The TPU-native mapping
 
 Messages are pytrees of numpy arrays; an ingest message is a dict with
 stacked transition fields plus "priorities".
+
+A third, low-rate path rides the same interface: fleet telemetry.
+`send_telemetry(frame)` ships a compact per-peer obs snapshot (JSON
+dict); the receiving side exposes an `on_telemetry(peer_id, frame)`
+hook the driver's fleet aggregator installs. On loopback the frame is
+handed to the hook directly; over sockets it becomes MSG_TELEMETRY and
+is subject to hello/ack capability negotiation (old peers drop it
+cleanly — see comm.socket_transport).
 """
 
 from __future__ import annotations
@@ -30,6 +38,7 @@ class Transport(Protocol):
     def recv_experience(self, timeout: float | None = None) -> dict | None: ...
     def publish_params(self, params: Any, version: int) -> None: ...
     def get_params(self) -> tuple[Any, int]: ...
+    def send_telemetry(self, frame: dict) -> bool: ...
 
 
 class LoopbackTransport:
@@ -41,6 +50,9 @@ class LoopbackTransport:
         self._version = -1
         self._lock = threading.Lock()
         self._dropped = 0
+        self._telemetry_frames = 0
+        # fleet hook (set by the driver); called inline from the sender
+        self.on_telemetry: Any = None  # (peer_id: str, frame: dict) -> None
 
     # experience path (actor -> replay ingest)
 
@@ -83,3 +95,22 @@ class LoopbackTransport:
     def get_params(self) -> tuple[Any, int]:
         with self._lock:
             return self._params, self._version
+
+    # telemetry path (peer obs snapshots -> fleet aggregator)
+
+    def send_telemetry(self, frame: dict) -> bool:
+        """In-process delivery straight to the aggregator hook; True
+        iff a hook was installed (mirrors the socket transport's
+        negotiated/not-negotiated return)."""
+        cb = self.on_telemetry
+        if cb is None:
+            return False
+        with self._lock:
+            self._telemetry_frames += 1
+        cb(str(frame.get("peer", "peer?")), frame)
+        return True
+
+    @property
+    def telemetry_frames(self) -> int:
+        with self._lock:
+            return self._telemetry_frames

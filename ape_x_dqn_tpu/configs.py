@@ -243,6 +243,13 @@ class ObsConfig:
     # (hbm/<jit>/<field> keys — the measured anchors utils/hbm.py's
     # static budget calibrates against)
     hbm_dump: bool = True
+    # fleet telemetry cadence (obs/fleet.py): remote actor hosts ship
+    # a MSG_TELEMETRY snapshot frame this often; the learner-side
+    # aggregator merges them into the run JSONL under peer/<id>/ keys
+    # and re-beats remote heartbeats into the stall watchdog. 0
+    # disables the emitter thread (frames also require both wire ends
+    # to negotiate the capability — an old peer degrades to none).
+    telemetry_every_s: float = 2.0
 
 
 @dataclass(frozen=True)

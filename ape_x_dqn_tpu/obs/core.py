@@ -36,6 +36,10 @@ AGE_EDGES = geometric_edges(1.0, 1e6, per_decade=4)
 LAG_EDGES = geometric_edges(1.0, 1e5, per_decade=4)
 TD_EDGES = geometric_edges(1e-3, 1e3, per_decade=4)
 BATCH_EDGES = tuple(float(2 ** i) for i in range(12))
+# inference request latency (enqueue -> result scatter), milliseconds:
+# sub-ms when the server keeps up, deadline_ms-ish when batching, and
+# unbounded when the queue backs up — the serving-SLO instrument
+LATENCY_EDGES = geometric_edges(0.1, 1e4, per_decade=4)
 
 
 class NullObs:
@@ -161,6 +165,7 @@ class Obs:
         self.registry.histogram("param_lag_steps", LAG_EDGES)
         self.registry.histogram("td_abs", TD_EDGES)
         self.registry.histogram("server_batch_items", BATCH_EDGES)
+        self.registry.histogram("infer_latency_ms", LATENCY_EDGES)
         self._learner_step = 0
         # jax.profiler window: False = armed, True = tracing,
         # None = done/disabled (single capture per run)

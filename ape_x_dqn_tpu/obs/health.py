@@ -237,6 +237,17 @@ class HeartbeatRegistry:
         with self._lock:
             return dict(self._beats)
 
+    def ages(self, now: float | None = None) -> dict[str, tuple[float, str]]:
+        """component -> (age_s, last_note). The clock-domain-free view
+        a fleet telemetry frame ships: an AGE survives the wire where
+        an absolute monotonic stamp from another host would not — the
+        receiver re-beats with `now = local_now - age_s` and the stall
+        watchdog covers the remote component as if it were local."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {name: (max(now - t, 0.0), note)
+                    for name, (t, note) in self._beats.items()}
+
     def stale(self, timeout_s: float, now: float | None = None
               ) -> list[tuple[str, float, str]]:
         """(component, staleness_s, last_note) for every component

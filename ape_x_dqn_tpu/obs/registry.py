@@ -204,6 +204,23 @@ class MetricRegistry:
                     name, edges if edges is not None else geometric_edges())
             return h
 
+    def snapshot_frame(self) -> dict[str, Any]:
+        """Every instrument's current value as one JSON-safe dict
+        (`{"ctr": {...}, "gauge": {...}, "hist": {...}}`) — the
+        instrument payload of a fleet telemetry frame (obs/fleet.py).
+        Same snapshots publish() folds into the JSONL, minus the
+        kind-prefix flattening: the frame keeps them nested so the
+        aggregator can re-prefix them per peer."""
+        with self._lock:
+            counters = list(self._counters.values())
+            gauges = list(self._gauges.values())
+            hists = list(self._hists.values())
+        return {
+            "ctr": {c.name: c.value for c in counters},
+            "gauge": {g.name: g.value for g in gauges},
+            "hist": {h.name: h.snapshot() for h in hists},
+        }
+
     def publish(self, metrics, step: int,
                 extra: dict[str, Any] | None = None) -> None:
         """One JSONL record carrying every instrument's current value:

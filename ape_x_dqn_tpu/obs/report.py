@@ -46,14 +46,29 @@ INSTRUMENTS = {
                  "publish_every periods")},
     "td_abs": {"kind": "hist"},
     "server_batch_items": {"kind": "hist"},
+    "infer_latency_ms": {
+        "kind": "hist",
+        "warn": ("p99", 100.0,
+                 "p99 inference latency beyond ~100ms means actors "
+                 "wait on the server more than they step envs — the "
+                 "queue is backing up or a compile stole the window")},
     "ingest_staging_occupancy": {"kind": "gauge"},
     "ingest_coalesce_width": {"kind": "gauge"},
     "ingest_decode_ms": {"kind": "gauge"},
     "wire_compression_ratio": {"kind": "gauge"},
     "replay_occupancy": {"kind": "gauge"},
-    "server_queue_depth": {"kind": "gauge"},
+    "server_queue_depth": {
+        "kind": "gauge",
+        "warn": ("value", 64,
+                 "a queue deeper than max_batch at publish time means "
+                 "dynamic batching is saturated — requests wait whole "
+                 "extra batch rounds")},
     "stall_errors": {"kind": "ctr"},
     "replay_adds": {"kind": "ctr"},
+    # fleet telemetry plane (obs/fleet.py)
+    "telemetry_frames": {"kind": "ctr"},
+    "peer_disconnects": {"kind": "ctr"},
+    "fleet_peers": {"kind": "gauge"},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -82,6 +97,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     state); stall events accumulate."""
     latest: dict[str, Any] = {}
     stalls: list[dict] = []
+    disconnects: list[dict] = []
     for rec in records:
         for k, v in rec.items():
             if v is not None:
@@ -91,6 +107,23 @@ def summarize(records: list[dict]) -> dict[str, Any]:
                            "component": rec["stall_component"],
                            "staleness_s": rec.get("stall_staleness_s"),
                            "note": rec.get("stall_note")})
+        if rec.get("peer_disconnect") is not None:
+            disconnects.append({"step": rec.get("step"),
+                                "peer": rec["peer_disconnect"]})
+    # fleet telemetry: `peer/<id>/<kind>/<name>` keys the aggregator
+    # merges into the stream (obs/fleet.py) regroup into one dict per
+    # peer — {"seq": n, "ctr": {...}, "gauge": {...}, "hist": {...},
+    # "span": {...}, "hb": {...}}
+    peers: dict[str, dict[str, Any]] = {}
+    for k, v in latest.items():
+        if not k.startswith("peer/"):
+            continue
+        parts = k.split("/", 3)
+        if len(parts) == 3:  # peer/<id>/seq
+            peers.setdefault(parts[1], {})[parts[2]] = v
+        elif len(parts) == 4:
+            peers.setdefault(parts[1], {}).setdefault(
+                parts[2], {})[parts[3]] = v
     spans = {k[len("span/"):]: v for k, v in latest.items()
              if k.startswith("span/") and isinstance(v, dict)}
     hists = {k[len("hist/"):]: v for k, v in latest.items()
@@ -117,6 +150,8 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "hists": hists,
         "gauges": gauges,
         "hbm": hbm,
+        "peers": peers,
+        "disconnects": disconnects,
         "stalls": stalls,
     }
 
@@ -212,6 +247,83 @@ def _fmt_ingest(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+def _fmt_slo(summary: dict[str, Any]) -> list[str]:
+    """Live serving-SLO view: inference latency percentiles and every
+    gauge with a healthy-range rule, each flagged when outside it."""
+    hists = summary.get("hists", {})
+    gauges = summary.get("gauges", {})
+    lat = hists.get("infer_latency_ms")
+    gauge_rows = [(name, gauges[name]) for name, row in INSTRUMENTS.items()
+                  if row["kind"] == "gauge" and "warn" in row
+                  and name in gauges]
+    if not lat and not gauge_rows:
+        return []
+    lines = ["serving SLOs:"]
+    if lat and int(lat.get("count", 0)):
+        lines.append(
+            f"  infer latency (ms)     p50={_n(lat.get('p50'))} "
+            f"p99={_n(lat.get('p99'))} max={_n(lat.get('max'))} "
+            f"over n={int(lat['count'])} requests "
+            f"(healthy p99 < {HEALTHY['infer_latency_ms'][1]})")
+    for name, v in gauge_rows:
+        _, bound, why = HEALTHY[name]
+        flag = float(v) > bound
+        lines.append(f"  {name:<22} {_n(v)} "
+                     f"(healthy ≤ {_n(float(bound))})")
+        if flag:
+            lines.append(f"    ⚠ value={_n(v)} exceeds healthy "
+                         f"~{bound}: {why}")
+    return lines
+
+
+def _fmt_peers(summary: dict[str, Any]) -> list[str]:
+    """Per-peer fleet telemetry: one block per remote actor host with
+    its heartbeat ages, ingest rate, stage-time breakdown, and any
+    histogram rows (healthy-range flags apply to remote instruments
+    exactly as to local ones)."""
+    peers = summary.get("peers", {})
+    if not peers:
+        return []
+    lines = [f"fleet peers ({len(peers)}):"]
+    for peer in sorted(peers):
+        p = peers[peer]
+        rate = p.get("gauge", {}).get("ingest_rate")
+        head = f"  peer {peer}: frame seq={_n(p.get('seq'))}"
+        if rate is not None:
+            head += f", ingest rate={float(rate):.1f} rows/s"
+        lines.append(head)
+        hb = p.get("hb", {})
+        if hb:
+            ages = ", ".join(f"{name}={float(age):.1f}s"
+                             for name, age in sorted(hb.items()))
+            lines.append(f"    heartbeat ages: {ages}")
+        spans = {k: v for k, v in p.get("span", {}).items()
+                 if isinstance(v, dict)}
+        if spans:
+            lines.append(f"    stage-time breakdown ({peer}):")
+            grand = sum(s.get("total_s", 0.0)
+                        for s in spans.values()) or 1.0
+            for name, s in sorted(spans.items(),
+                                  key=lambda kv: -kv[1].get("total_s", 0.0)):
+                count = int(s.get("count", 0))
+                total = float(s.get("total_s", 0.0))
+                mean_ms = total / count * 1e3 if count else 0.0
+                lines.append(f"      {name:<24} {count:>8} "
+                             f"{total:>9.3f}s {mean_ms:>8.3f}ms/ea "
+                             f"{total / grand:>6.1%}")
+        for name in sorted(p.get("hist", {})):
+            h = p["hist"][name]
+            if isinstance(h, dict) and int(h.get("count", 0)):
+                lines.extend("    " + ln
+                             for ln in _fmt_hist(name, h))
+    disconnects = summary.get("disconnects", [])
+    if disconnects:
+        lines.append(f"  peer disconnects: {len(disconnects)}")
+        for d in disconnects:
+            lines.append(f"    step={_n(d['step'])} peer={d['peer']}")
+    return lines
+
+
 def _n(v) -> str:
     if v is None:
         return "-"
@@ -241,10 +353,18 @@ def format_report(summary: dict[str, Any]) -> str:
         lines.append("staleness / distribution percentiles:")
         for name in sorted(summary["hists"]):
             lines.extend(_fmt_hist(name, summary["hists"][name]))
+    slo_lines = _fmt_slo(summary)
+    if slo_lines:
+        lines.append("")
+        lines.extend(slo_lines)
     ingest_lines = _fmt_ingest(summary)
     if ingest_lines:
         lines.append("")
         lines.extend(ingest_lines)
+    peer_lines = _fmt_peers(summary)
+    if peer_lines:
+        lines.append("")
+        lines.extend(peer_lines)
     if summary["hbm"]:
         lines.append("")
         lines.append("compiled memory (XLA memory_analysis, bytes):")
@@ -272,17 +392,53 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of the text report")
+    ap.add_argument("--follow", action="store_true",
+                    help="live-tail mode: re-summarize and re-print "
+                         "whenever the JSONL grows (the fleet "
+                         "aggregator appends per-peer frames as they "
+                         "arrive); stop with Ctrl-C")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval for --follow (seconds)")
     args = ap.parse_args(argv)
-    records = load_records(args.jsonl)
-    if not records:
-        print(f"no records in {args.jsonl}", file=sys.stderr)
-        return 1
-    summary = summarize(records)
-    if args.json:
-        print(json.dumps(summary))
-    else:
-        print(format_report(summary))
-    return 0
+    if not args.follow:
+        records = load_records(args.jsonl)
+        if not records:
+            print(f"no records in {args.jsonl}", file=sys.stderr)
+            return 1
+        summary = summarize(records)
+        print(json.dumps(summary) if args.json
+              else format_report(summary))
+        return 0
+    return _follow(args.jsonl, args.interval, args.json)
+
+
+def _follow(path: str, interval: float, as_json: bool) -> int:
+    """Live tail: poll the JSONL's size, re-print the full report on
+    growth. Re-summarizing from scratch keeps this trivially correct
+    (last-write-wins folding is not incremental-friendly) and the files
+    are small — one record per publish/frame, not per transition."""
+    import os
+    import time as _time
+
+    last_size = -1
+    try:
+        while True:
+            try:
+                size = os.stat(path).st_size
+            except OSError:
+                size = -1  # not created yet; keep polling
+            if size != last_size and size > 0:
+                last_size = size
+                records = load_records(path)
+                if records:
+                    summary = summarize(records)
+                    out = (json.dumps(summary) if as_json
+                           else format_report(summary))
+                    print(f"--- {path} @ {size} bytes ---")
+                    print(out, flush=True)
+            _time.sleep(max(interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
 
 
 if __name__ == "__main__":

@@ -51,6 +51,10 @@ class NullTracer:
     def mark(self, name: str, **args: Any) -> None:
         pass
 
+    def remote_span(self, name: str, dur_s: float, age_s: float = 0.0,
+                    peer: str = "", **args: Any) -> None:
+        pass
+
     def aggregates(self) -> dict[str, dict[str, float]]:
         return {}
 
@@ -80,6 +84,7 @@ class SpanTracer:
         self._events: list[dict] = []
         self._dropped = 0
         self._thread_names: dict[int, str] = {}
+        self._peer_tids: dict[str, int] = {}  # synthetic remote tracks
         self._agg: dict[str, list[float]] = {}  # name -> [count, total, max]
         self._t0 = time.perf_counter()
         self._closed = False
@@ -99,16 +104,39 @@ class SpanTracer:
         t = time.perf_counter()
         self._record(name, t, t + 1e-6, args, fused=True)
 
+    def remote_span(self, name: str, dur_s: float, age_s: float = 0.0,
+                    peer: str = "", **args: Any) -> None:
+        """Record a span REPORTED by a remote peer over the telemetry
+        wire. The peer's clock domain does not cross the wire; only the
+        event's AGE does — the event lands at local-now minus age_s on
+        a synthetic `peer/<id>` track. That keeps cross-process
+        correlation honest: ordering within a track and the shared
+        correlation args (batch_id) are exact, absolute alignment
+        across tracks is age-accurate only."""
+        now = time.perf_counter()
+        t1 = now - max(float(age_s), 0.0)
+        t0 = t1 - max(float(dur_s), 0.0)
+        label = f"peer/{peer or '?'}"
+        with self._lock:
+            tid = self._peer_tids.get(label)
+            if tid is None:
+                # high base keeps synthetic tids clear of OS thread ids
+                tid = self._peer_tids[label] = 1 << 40 | len(self._peer_tids)
+                self._thread_names[tid] = label
+        self._record(name, t0, t1, dict(args, peer=peer), tid=tid)
+
     def _record(self, name: str, t0: float, t1: float, args: dict,
-                fused: bool = False) -> None:
-        tid = threading.get_ident()
+                fused: bool = False, tid: int | None = None) -> None:
+        local = tid is None
+        if local:
+            tid = threading.get_ident()
         ev = {"name": name, "cat": "apex", "ph": "X",
               "ts": (t0 - self._t0) * 1e6, "dur": (t1 - t0) * 1e6,
               "pid": os.getpid(), "tid": tid}
         if args:
             ev["args"] = args
         with self._lock:
-            if tid not in self._thread_names:
+            if local and tid not in self._thread_names:
                 self._thread_names[tid] = threading.current_thread().name
             a = self._agg.get(name)
             if a is None:

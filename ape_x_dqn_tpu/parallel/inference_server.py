@@ -41,7 +41,7 @@ from ape_x_dqn_tpu.utils.misc import next_pow2
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "event", "result")
+    __slots__ = ("inputs", "n", "event", "result", "t_enq")
 
     def __init__(self, inputs: Any, n: int = 0):
         """n == 0: single item, no batch dim on any leaf.
@@ -51,6 +51,7 @@ class _Request:
         self.n = n
         self.event = threading.Event()
         self.result: Any = None
+        self.t_enq = time.perf_counter()  # serving-SLO latency anchor
 
     @property
     def items(self) -> int:
@@ -293,6 +294,7 @@ class BatchedInferenceServer:
             out = self._apply(params, stacked)
             out_np = jax.tree.map(np.asarray, out)
         off = 0
+        t_done = time.perf_counter()
         for r in reqs:
             if r.n:
                 lo, hi = off, off + r.n
@@ -301,6 +303,12 @@ class BatchedInferenceServer:
                 idx = off
                 r.result = jax.tree.map(lambda x: x[idx], out_np)
             off += r.items
+            # end-to-end request latency (enqueue -> result ready):
+            # the serving SLO — covers queue wait, batching deadline,
+            # the forward, and the scatter, which is what an actor
+            # actually blocks on
+            self._obs.observe("infer_latency_ms",
+                              (t_done - r.t_enq) * 1e3)
             r.event.set()
         # stats() reads these from other threads; the serve thread is
         # the only writer but += is still a read-modify-write

@@ -257,6 +257,8 @@ class Actor(DiscretePolicyHooks):
             seg["frames"] = self._frames_unshipped
             self._frames_unshipped = 0
             self.transport.send_experience(seg)
+        if segs:
+            self.obs.mark("actor.ship", segments=len(segs))
 
     def _ship(self, force: bool = False) -> None:
         if self._seg is not None:
@@ -266,10 +268,12 @@ class Actor(DiscretePolicyHooks):
             return
         if not force and len(self._outbox) < self.cfg.actors.ingest_batch:
             return
+        rows = len(self._outbox)
         ship_flat_outbox(self._outbox, self._action_array, self.index,
                          self._frames_unshipped, self.transport)
         self._outbox = []
         self._frames_unshipped = 0
+        self.obs.mark("actor.ship", rows=rows)
 
     # -- main loop ---------------------------------------------------------
 
@@ -403,10 +407,12 @@ class RecurrentActor(Actor):
             return
         if not force and len(self._outbox) < self.ship_after:
             return
+        rows = len(self._outbox)
         ship_sequence_outbox(self._outbox, self.index,
                              self._frames_unshipped, self.transport)
         self._outbox = []
         self._frames_unshipped = 0
+        self.obs.mark("actor.ship", sequences=rows)
 
     # -- main loop ---------------------------------------------------------
 

@@ -16,6 +16,9 @@ Entry points:
 
 from __future__ import annotations
 
+import dataclasses
+import os
+import socket as socket_mod
 import sys
 import threading
 import time
@@ -24,9 +27,19 @@ from ape_x_dqn_tpu.comm.socket_transport import SocketTransport
 from ape_x_dqn_tpu.configs import RunConfig
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
+from ape_x_dqn_tpu.obs.core import build_obs
+from ape_x_dqn_tpu.obs.fleet import StampingTransport, TelemetryEmitter
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, server_apply_fn, warmup_example)
+from ape_x_dqn_tpu.utils.metrics import Metrics
+
+
+def default_peer_id(actor_offset: int = 0) -> str:
+    """Stable-for-the-process, unique-across-the-fleet peer identity:
+    hostname + pid + this host's slot in the global actor schedule."""
+    return (f"{socket_mod.gethostname()}-{os.getpid()}"
+            f"-a{actor_offset}")
 
 
 def run_actor_host(cfg: RunConfig, host: str, port: int,
@@ -35,16 +48,37 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
                    frames_per_actor: int | None = None,
                    param_poll_s: float = 2.0,
                    stop_event: threading.Event | None = None,
-                   wait_for_params_s: float = 60.0) -> dict:
+                   wait_for_params_s: float = 60.0,
+                   peer_id: str | None = None) -> dict:
     """Run actors against a remote learner until their frame budget ends.
 
     actor_offset positions this host's actors inside the global eps_i
     schedule (host k of m runs indices [k*n, (k+1)*n) of num_actors*m).
+
+    peer_id names this host on the fleet telemetry plane (obs/fleet.py);
+    with obs enabled, experience batches are stamped with it plus a
+    monotonic batch_id, and a TelemetryEmitter ships obs snapshot
+    frames to the learner every cfg.obs.telemetry_every_s.
     """
     n = num_actors or cfg.actors.num_actors
     stop_event = stop_event or threading.Event()
+    peer = peer_id or default_peer_id(actor_offset)
     transport = SocketTransport(host, port,
                                 wire_codec=cfg.comm.wire_codec)
+    # local obs: metrics stay in-memory (the learner's JSONL is the
+    # run's single artifact; this host's view crosses the wire as
+    # telemetry frames), and a trace path gets a per-peer suffix so
+    # co-located hosts don't clobber the learner's trace file
+    obs_cfg = cfg.obs
+    if obs_cfg.trace_path:
+        obs_cfg = dataclasses.replace(
+            obs_cfg, trace_path=f"{obs_cfg.trace_path}.{peer}")
+    obs = build_obs(obs_cfg, Metrics())
+    emitter: TelemetryEmitter | None = None
+    if obs.enabled:
+        transport = StampingTransport(transport, peer)
+        emitter = TelemetryEmitter(transport, obs, peer,
+                                   interval_s=cfg.obs.telemetry_every_s)
 
     # wait for the learner to publish a first param set
     deadline = time.monotonic() + wait_for_params_s
@@ -66,8 +100,11 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     server = BatchedInferenceServer(
         server_apply_fn(family, net), params,
         max_batch=cfg.inference.max_batch,
-        deadline_ms=cfg.inference.deadline_ms)
+        deadline_ms=cfg.inference.deadline_ms,
+        obs=obs if obs.enabled else None)
     server.update_params(params, version)
+    if emitter is not None:
+        emitter.start()
     try:  # pre-compile the forward so first queries don't time out
         server.warmup(warmup_example(family, cfg, probe.spec),
                       extra_sizes=(cfg.actors.envs_per_actor,))
@@ -100,8 +137,10 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     def actor_thread(slot: int) -> None:
         idx = actor_offset + slot
         try:
-            actor = cls(cfg, idx, query, transport)
+            actor = cls(cfg, idx, query, transport,
+                        obs=obs if obs.enabled else None)
             frames[slot] = actor.run(per_actor, stop_event)
+            obs.clear(f"actor-{idx}")  # finished, not stalled
         except Exception as e:  # noqa: BLE001 - reported to caller
             errors.append((idx, e))
 
@@ -116,6 +155,9 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     stop_event.set()
     puller.join(timeout=2)
     server.stop()
+    if emitter is not None:
+        emitter.stop()  # ships one shutdown-fresh frame
+    obs.close()
     transport.close()
     return {"frames": sum(frames), "actors": n,
             "dropped": transport.dropped, "errors": errors,
@@ -125,7 +167,10 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
                 transport.wire_compression_ratio, 3),
             "encode_ms": round(transport.encode_ms, 1),
             "param_bytes_in": transport.bytes_in,
-            "last_param_version": server.params_version}
+            "last_param_version": server.params_version,
+            "peer_id": peer,
+            "telemetry_negotiated": transport.telemetry_negotiated,
+            "telemetry_frames_out": transport.telemetry_frames_out}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -160,6 +205,11 @@ def main(argv: list[str] | None = None) -> int:
                          "raise this toward the eps-staleness you can "
                          "tolerate (Ape-X actors pull every ~400 env "
                          "steps)")
+    ap.add_argument("--peer-id", default=None,
+                    help="name of this host on the fleet telemetry "
+                         "plane (default: hostname-pid-a<offset>); "
+                         "shows up as peer/<id>/ in the learner's "
+                         "report and in stall attributions")
     ap.add_argument("--set", action="append", default=[],
                     metavar="dotted.key=value")
     args = ap.parse_args(argv)
@@ -168,7 +218,8 @@ def main(argv: list[str] | None = None) -> int:
     out = run_actor_host(cfg, host, int(port), num_actors=args.actors,
                          actor_offset=args.actor_offset,
                          frames_per_actor=args.frames_per_actor,
-                         param_poll_s=args.param_poll_s)
+                         param_poll_s=args.param_poll_s,
+                         peer_id=args.peer_id)
     print(out)
     return 1 if out["errors"] else 0
 

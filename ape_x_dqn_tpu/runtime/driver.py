@@ -31,6 +31,7 @@ from ape_x_dqn_tpu.comm.transport import LoopbackTransport
 from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.obs.core import build_obs
+from ape_x_dqn_tpu.obs.fleet import MAX_SPAN_IDS, FleetAggregator
 from ape_x_dqn_tpu.obs.health import make_lock
 from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
@@ -178,6 +179,15 @@ class ApexDriver:
             obs=self.obs)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
+        # fleet telemetry plane (obs/fleet.py): with obs on and a
+        # telemetry-capable transport, remote peers' snapshot frames
+        # merge into this run's JSONL under peer/<id>/ keys and their
+        # heartbeats feed the stall watchdog below
+        self.fleet: FleetAggregator | None = None
+        if self.obs.enabled:
+            agg = FleetAggregator(self.obs)
+            if agg.install(self.transport):
+                self.fleet = agg
         # initial publication so remote actor hosts can bootstrap before
         # the learner's first publish_every boundary (they block on
         # get_params); both sides only read these buffers
@@ -433,8 +443,34 @@ class ApexDriver:
         # sequence batches carry fewer items than env frames; actors ship
         # the true frame count alongside (flat batches: frames == items)
         frames = int(batch.get("frames", n))
+        # cross-process correlation (obs/fleet.StampingTransport): a
+        # stamped batch's learner-side staging gets its own span sharing
+        # the origin's batch_id, so the trace reconstructs the
+        # actor->wire->staging->add journey; the tag rides the stager
+        # into the replay.add dispatch that carries it
+        bid = batch.get("batch_id")
+        if bid is None:
+            self._stage_one(batch, n)
+        else:
+            peer = str(batch.get("peer", ""))
+            with self.obs.span("ingest.batch", batch_id=int(bid),
+                               peer=peer, rows=n):
+                self._stage_one(batch, n, tag=(peer, int(bid)))
+        # wire codec accounting: WireBatch knows both its wire size and
+        # its decoded size (header-only); dict batches came in locally
+        # and have no wire footprint to report
+        wire = getattr(batch, "wire_nbytes", 0)
+        if wire:
+            self.obs.gauge("wire_compression_ratio",
+                           batch.raw_nbytes / wire)
+        self.frames.add(frames)
+        with self._lock:
+            self._frames_total += frames
+            self._ingested_batches += 1
+
+    def _stage_one(self, batch: dict, n: int, tag=None) -> None:
         if self._stager is not None:
-            self._stager.put(batch)
+            self._stager.put(batch, tag=tag)
             # below min_fill the learner is stalled waiting on replay:
             # ship complete blocks eagerly (warmed g=1 graph) instead of
             # letting coalescing delay the first train dispatch by up to
@@ -449,17 +485,6 @@ class ApexDriver:
             self._stage.append(batch)
             self._stage_n += n
             self._flush_stage()
-        # wire codec accounting: WireBatch knows both its wire size and
-        # its decoded size (header-only); dict batches came in locally
-        # and have no wire footprint to report
-        wire = getattr(batch, "wire_nbytes", 0)
-        if wire:
-            self.obs.gauge("wire_compression_ratio",
-                           batch.raw_nbytes / wire)
-        self.frames.add(frames)
-        with self._lock:
-            self._frames_total += frames
-            self._ingested_batches += 1
 
     def _ship_staged(self, views: dict, g: int) -> list:
         """Ship g coalesced staged blocks (IngestStager callback): async
@@ -488,8 +513,15 @@ class ApexDriver:
         staged = {k: put(v) for k, v in views.items()}
         pris = staged.pop("priorities")
         handles = list(staged.values()) + [pris]
+        # correlation tail: the origin batch_ids staged into this
+        # dispatch (truncated — attribution, not an exhaustive ledger)
+        span_args: dict = {"units": count}
+        tags = self._stager.shipping_tags if self._stager is not None \
+            else ()
+        if tags:
+            span_args["batch_ids"] = [t[1] for t in tags[:MAX_SPAN_IDS]]
         with self._state_lock:
-            with self.obs.span("replay.add", units=count):
+            with self.obs.span("replay.add", **span_args):
                 if g > 1:
                     self.state = self.learner.add_many(self.state, staged,
                                                        pris)

@@ -80,6 +80,13 @@ class IngestStager:
         # — obs surfaces it as ingest_decode_ms per put
         self.decode_ms = 0.0
         self.last_put_decode_ms = 0.0
+        # cross-process correlation: tags (e.g. (peer, batch_id) from
+        # the wire header) of batches staged since the last ship; the
+        # ship callback reads `shipping_tags` to attribute the device
+        # dispatch. Approximate by design — a batch that straddles a
+        # buffer boundary is attributed to the ship that took its head
+        self._pending_tags: list = []
+        self.shipping_tags: tuple = ()
 
     # -- write side --------------------------------------------------------
 
@@ -92,10 +99,13 @@ class IngestStager:
             jax.block_until_ready(self._inflight[i])
             self._inflight[i] = []
 
-    def put(self, batch) -> None:
+    def put(self, batch, tag=None) -> None:
         """Stage one ingest message (WireBatch or plain dict of arrays),
         splitting across buffer boundaries; full buffers ship as one
-        coalesced add_many."""
+        coalesced add_many. `tag` is an opaque correlation handle
+        surfaced via `shipping_tags` on the ship that carries it."""
+        if tag is not None:
+            self._pending_tags.append(tag)
         wire = hasattr(batch, "decode_into")
         total = batch.rows if wire \
             else int(batch["priorities"].shape[0])
@@ -124,6 +134,8 @@ class IngestStager:
         """Full buffer -> one add_many dispatch; rotate to the next
         buffer while the transfer flies."""
         buf = self._bufs[self._active]
+        self.shipping_tags = tuple(self._pending_tags)
+        self._pending_tags = []
         self._inflight[self._active] = list(
             self._ship({k: buf[k] for k in self._keys}, self.coalesce))
         self._active = (self._active + 1) % self.nb
@@ -143,6 +155,8 @@ class IngestStager:
             return 0
         buf = self._bufs[self._active]
         shipped = nblocks * self.block
+        self.shipping_tags = tuple(self._pending_tags)
+        self._pending_tags = []
         handles: list = []
         for b in range(nblocks):
             views = {k: buf[k][b * self.block:(b + 1) * self.block]

@@ -214,6 +214,16 @@ class MultihostApexDriver:
             mesh=self._inference_mesh, obs=self.obs)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
+        # fleet telemetry (obs/fleet.py): merge remote actor hosts'
+        # snapshot frames into this process's JSONL — purely host-local
+        # (no collectives), so it cannot perturb the lockstep rounds
+        self.fleet = None
+        if self.obs.enabled:
+            from ape_x_dqn_tpu.obs.fleet import FleetAggregator
+
+            agg = FleetAggregator(self.obs)
+            if agg.install(self.transport):
+                self.fleet = agg
         self.transport.publish_params(server_params, 0)
 
         self.stop_event = threading.Event()

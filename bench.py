@@ -852,6 +852,138 @@ def wire_codec_summary() -> dict:
             "codec_bytes_per_transition": round(comp / transitions, 1)}
 
 
+def _telemetry_soak(telemetry: bool, msgs: list[dict], iters: int,
+                    pump_interval_s: float = 0.05) -> dict:
+    """One arm of the telemetry A/B: ship the message list over a real
+    loopback socket pair `iters` times with the fleet telemetry plane
+    either fully ON (StampingTransport + TelemetryEmitter on the
+    client, FleetAggregator merging frames on the server) or fully OFF
+    (plain transport, capability not even offered), and measure
+    experience items/s plus the telemetry side-channel's own rate."""
+    import threading
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport)
+    from ape_x_dqn_tpu.configs import ObsConfig
+    from ape_x_dqn_tpu.obs.core import build_obs
+    from ape_x_dqn_tpu.obs.fleet import (
+        FleetAggregator, StampingTransport, TelemetryEmitter)
+    from ape_x_dqn_tpu.utils.metrics import Metrics
+
+    n_wire = int(msgs[0]["priorities"].shape[0])
+    b = int(msgs[0]["priorities"].shape[1])
+    total_units = len(msgs) * iters * n_wire
+    srv = SocketIngestServer("127.0.0.1", 0)
+    client = SocketTransport("127.0.0.1", srv.port, telemetry=telemetry)
+    learner_obs = actor_obs = emitter = None
+    tr = client
+    if telemetry:
+        learner_obs = build_obs(
+            ObsConfig(enabled=True, heartbeat_timeout_s=0.0), Metrics())
+        FleetAggregator(learner_obs).install(srv)
+        actor_obs = build_obs(
+            ObsConfig(enabled=True, heartbeat_timeout_s=0.0), Metrics())
+        actor_obs.beat("actor-0", "bench")
+        tr = StampingTransport(client, "bench-peer")
+        emitter = TelemetryEmitter(tr, actor_obs, "bench-peer",
+                                   interval_s=pump_interval_s)
+    got = {"units": 0}
+    done = threading.Event()
+
+    def consume() -> None:
+        while got["units"] < total_units:
+            m = srv.recv_experience(timeout=10)
+            if m is None:
+                break
+            got["units"] += m.rows
+        done.set()
+
+    thread = threading.Thread(target=consume, daemon=True)
+    t0 = time.monotonic()
+    thread.start()
+    if emitter is not None:
+        emitter.start()
+    for _ in range(iters):
+        for batch in msgs:
+            tr.send_experience(batch)
+    done.wait(timeout=60)
+    if emitter is not None:
+        emitter.stop()
+    dt = time.monotonic() - t0
+    out = {
+        "items_per_s": total_units * b / dt,
+        "telemetry_frames_per_s": srv.telemetry_frames / dt,
+        "telemetry_bytes_per_s": srv.telemetry_bytes_in / dt,
+    }
+    client.close()
+    srv.stop()
+    if actor_obs is not None:
+        actor_obs.close()
+    if learner_obs is not None:
+        learner_obs.close()
+    assert got["units"] == total_units, \
+        f"consumer saw {got['units']}/{total_units} units"
+    return out
+
+
+def bench_telemetry_ab(args, repeats: int | None = None,
+                       n_msgs: int = 4, iters: int = 6) -> dict:
+    """A/B the fleet telemetry plane's cost on the experience path it
+    piggybacks on (obs/fleet.py): items/s with telemetry fully on
+    (batch stamping + frame pump + learner-side aggregation) vs fully
+    off, both orders on fresh socket pairs, median-of-`repeats` per
+    arm. The plane is designed to be a rounding error here — a compact
+    JSON frame every couple of seconds riding a link that carries MBs
+    of frames — so the adoption bar is overhead within the run-to-run
+    noise band, and this records the receipt."""
+    repeats = args.repeats if repeats is None else repeats
+    msgs = _wire_ab_messages(n_msgs)
+    out: dict = {"units_per_msg": int(msgs[0]["priorities"].shape[0])}
+    overheads = []
+    for order in ("off_first", "on_first"):
+        arms = (False, True) if order == "off_first" else (True, False)
+        runs: dict[bool, list] = {False: [], True: []}
+        last: dict[bool, dict] = {}
+        for _ in range(repeats):
+            for tel in arms:
+                r = _telemetry_soak(tel, msgs, iters)
+                runs[tel].append(r["items_per_s"])
+                last[tel] = r
+        overhead = 100.0 * (1.0 - spread(runs[True])["median"]
+                            / spread(runs[False])["median"])
+        overheads.append(overhead)
+        out[order] = {
+            "off_items_per_s": spread(runs[False]),
+            "on_items_per_s": spread(runs[True]),
+            "frames_per_s": round(last[True]["telemetry_frames_per_s"], 1),
+            "bytes_per_s": round(last[True]["telemetry_bytes_per_s"]),
+            "overhead_pct": round(overhead, 1),
+        }
+        log(f"telemetry A/B [{order}]: off {spread(runs[False])} vs on "
+            f"{spread(runs[True])} items/s -> overhead "
+            f"{overhead:+.1f}% (frames "
+            f"{out[order]['frames_per_s']}/s, "
+            f"{out[order]['bytes_per_s']} B/s)")
+    out["overhead_pct"] = [round(x, 1) for x in overheads]
+    return out
+
+
+def telemetry_summary(args) -> dict:
+    """Cheap single-pass telemetry overhead receipt recorded in every
+    default bench run (one off arm + one on arm on a fresh socket
+    pair): frames/s + bytes/s of the side-channel and the items/s
+    overhead it cost. The full --telemetry-ab harness is the
+    both-orders, median-of-repeats version of this number."""
+    off = _telemetry_soak(False, _wire_ab_messages(2), 4)
+    on = _telemetry_soak(True, _wire_ab_messages(2), 4)
+    return {
+        "frames_per_s": round(on["telemetry_frames_per_s"], 1),
+        "bytes_per_s": round(on["telemetry_bytes_per_s"]),
+        "overhead_pct": round(
+            100.0 * (1.0 - on["items_per_s"] / off["items_per_s"]), 1),
+    }
+
+
 def bench_h2d(mb: int = 64, repeats: int = 3, iters: int = 4) -> list[float]:
     """Raw host->device link bandwidth: pure `device_put` MB/s of a
     pinned 64MB buffer, no compute. Round-4 verdict weak #1: the ingest
@@ -953,6 +1085,16 @@ def main() -> None:
                    "--wire-ab-cap-mb): bytes/transition + items/s, "
                    "recorded under secondary.wire_ab (PERF.md 'Wire "
                    "codec'). Runs INSTEAD of the main flagship bench")
+    p.add_argument("--telemetry-ab", action="store_true",
+                   help="run the fleet-telemetry overhead A/B "
+                   "(obs/fleet.py plane fully on — batch stamping, "
+                   "frame pump, learner-side aggregation — vs fully "
+                   "off, over a real loopback socket pair, both "
+                   "orders, median-of-`--repeats` per arm): items/s "
+                   "overhead plus the side-channel's own frames/s and "
+                   "bytes/s, recorded under secondary.telemetry_ab "
+                   "(PERF.md 'Observability'). Runs INSTEAD of the "
+                   "main flagship bench")
     p.add_argument("--wire-ab-cap-mb", type=float, default=10.5,
                    help="simulated link MB/s for the capped wire-ab "
                    "arm (default = the round-4 measured live ingest "
@@ -993,6 +1135,19 @@ def main() -> None:
                           "live_gap": ab["live_gap_new"]},
         }), flush=True)
         return
+    if args.telemetry_ab:
+        ab = bench_telemetry_ab(args)
+        worst = max(ab["overhead_pct"])
+        print(json.dumps({
+            "metric": "telemetry_overhead_pct",
+            "value": worst,
+            "unit": "%",
+            "vs_baseline": round(
+                ab["on_first"]["on_items_per_s"]["median"]
+                / ab["on_first"]["off_items_per_s"]["median"], 3),
+            "secondary": {"telemetry_ab": ab},
+        }), flush=True)
+        return
     if args.wire_ab:
         ab = bench_wire_ab(args)
         print(json.dumps({
@@ -1026,6 +1181,7 @@ def main() -> None:
         "h2d_mb_per_s": spread(h2d_rates),
         "sample_chunk": args.sample_chunk,
         "wire_codec": wire_codec_summary(),
+        "telemetry": telemetry_summary(args),
     }
     flops = train_step_flops_analytic(args.batch_size)
     achieved_tflops = gsps * flops / 1e12

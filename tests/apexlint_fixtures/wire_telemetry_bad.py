@@ -1,0 +1,28 @@
+"""wire-protocol fixture: MSG_TELEMETRY declared and sent by the
+client but never dispatched by the server, no waiver — the exact bug
+class the checker exists for (a new frame type silently dropped by an
+un-upgraded receiver). Exactly one finding, naming Server."""
+
+MSG_HELLO = 1
+MSG_EXPERIENCE = 2
+MSG_PARAMS = 3
+MSG_TELEMETRY = 7
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_HELLO:
+            return MSG_PARAMS
+        if mtype == MSG_EXPERIENCE:
+            return payload
+        return None  # telemetry frames fall through and vanish
+
+
+class Client:
+    def run(self, sock):
+        sock.send(MSG_HELLO)
+        if sock.recv() != MSG_PARAMS:
+            return False
+        sock.send(MSG_EXPERIENCE)
+        sock.send(MSG_TELEMETRY)
+        return True

@@ -1,0 +1,32 @@
+"""wire-protocol fixture: MSG_TELEMETRY wired into both chains —
+the server dispatches it, the client gates sends on negotiation."""
+
+MSG_HELLO = 1
+MSG_EXPERIENCE = 2
+MSG_PARAMS = 3
+MSG_TELEMETRY = 7
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_HELLO:
+            return MSG_PARAMS
+        if mtype == MSG_EXPERIENCE:
+            return payload
+        if mtype == MSG_TELEMETRY:
+            return self.on_frame(payload)
+        return None
+
+    def on_frame(self, payload):
+        return payload
+
+
+class Client:
+    def run(self, sock):
+        sock.send(MSG_HELLO)
+        if sock.recv() != MSG_PARAMS:
+            return False
+        sock.send(MSG_EXPERIENCE)
+        if self.negotiated:
+            sock.send(MSG_TELEMETRY)
+        return True

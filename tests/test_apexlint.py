@@ -113,6 +113,18 @@ def test_wire_protocol_fixtures():
     assert "MSG_PONG" in f.message and "Server" in f.message
 
 
+def test_wire_protocol_telemetry_fixtures():
+    good = wire_protocol.check_paths([_fx("wire_telemetry_good.py")])
+    assert good.findings == []
+    assert good.waivers == 0  # fully wired, nothing to excuse
+
+    bad = wire_protocol.check_paths([_fx("wire_telemetry_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "wire-protocol"
+    assert "MSG_TELEMETRY" in f.message and "Server" in f.message
+
+
 def test_obs_names_fixtures():
     report = _fx("obs_report_fixture.py")
     good = obs_names.check([_fx("obs_good.py")], report)

@@ -1,0 +1,291 @@
+"""Fleet telemetry plane coverage (ISSUE 6): per-peer obs snapshots
+over MSG_TELEMETRY, cross-process trace correlation via stamped
+batch_ids, remote stall attribution through re-beaten heartbeat ages,
+disconnect attribution, and the old-peer negotiation fallbacks — all
+over REAL loopback sockets where the wire is involved."""
+
+import json
+import socket as socket_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.comm.socket_transport import (
+    SocketIngestServer, SocketTransport)
+from ape_x_dqn_tpu.configs import ObsConfig
+from ape_x_dqn_tpu.obs.core import build_obs
+from ape_x_dqn_tpu.obs.fleet import (
+    FleetAggregator, StampingTransport, TelemetryEmitter, build_frame)
+from ape_x_dqn_tpu.obs.health import StallError
+from ape_x_dqn_tpu.obs.report import format_report, summarize
+from ape_x_dqn_tpu.obs.trace import load_trace
+from ape_x_dqn_tpu.utils.metrics import Metrics
+
+PEER = "hostA-1234-a0"
+
+
+def _experience_batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"obs": rng.random((n, 4)).astype(np.float32),
+            "action": rng.integers(0, 2, (n,)).astype(np.int32),
+            "priorities": (rng.random(n) + 0.1).astype(np.float32),
+            "actor": 0, "frames": n}
+
+
+def _actor_obs():
+    """Actor-host-side obs: in-memory metrics, no trace file (frames
+    carry the snapshot; the learner's JSONL is the run artifact)."""
+    obs = build_obs(ObsConfig(enabled=True, heartbeat_timeout_s=0.0),
+                    Metrics())
+    obs.beat("actor-0", "frame 128")
+    obs.count("replay_adds", 8)
+    obs.observe("infer_latency_ms", 3.0)
+    return obs
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- stamping + frame building ---------------------------------------------
+
+def test_stamping_transport_assigns_monotonic_batch_ids():
+    shipped = []
+
+    class _Sink:
+        def send_experience(self, batch):
+            shipped.append(batch)
+
+    st = StampingTransport(_Sink(), PEER)
+    for _ in range(3):
+        st.send_experience(_experience_batch())
+    assert [b["batch_id"] for b in shipped] == [0, 1, 2]
+    assert all(b["peer"] == PEER for b in shipped)
+    assert st.rows_out == 24
+    events = st.drain_events()
+    assert [e[3]["batch_id"] for e in events] == [0, 1, 2]
+    assert events[0][0] == "actor.ship" and events[0][3]["rows"] == 8
+    assert st.drain_events() == []  # drained: the ring was cleared
+
+
+def test_build_frame_is_json_safe_and_complete():
+    obs = _actor_obs()
+    frame = build_frame(obs, PEER, 7, events=[["actor.ship", 0.0, 0.1,
+                                               {"batch_id": 0}]],
+                        rows_out=64)
+    json.dumps(frame)  # the wire form: must serialize as-is
+    assert frame["peer"] == PEER and frame["seq"] == 7
+    assert frame["hb"]["actor-0"][1] == "frame 128"
+    assert frame["ctr"]["replay_adds"] == 8.0
+    assert frame["hist"]["infer_latency_ms"]["count"] == 1
+    assert frame["rows_out"] == 64
+    obs.close()
+
+
+# -- merged JSONL + per-peer report ----------------------------------------
+
+def test_telemetry_frames_merge_into_single_run_jsonl(tmp_path):
+    """Acceptance bar: a remote peer over a real socket lands in the
+    learner's ONE JSONL as peer/<id>/ rows, and the report prints a
+    per-peer stage breakdown with ingest rate and heartbeat ages."""
+    jsonl = str(tmp_path / "run.jsonl")
+    learner_metrics = Metrics(log_path=jsonl)
+    learner_obs = build_obs(
+        ObsConfig(enabled=True, heartbeat_timeout_s=0.0), learner_metrics)
+    server = SocketIngestServer("127.0.0.1", 0)
+    agg = FleetAggregator(learner_obs)
+    assert agg.install(server)
+
+    actor_obs = _actor_obs()
+    client = SocketTransport("127.0.0.1", server.port)
+    stamper = StampingTransport(client, PEER)
+    emitter = TelemetryEmitter(stamper, actor_obs, PEER, interval_s=0)
+    try:
+        stamper.send_experience(_experience_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+        assert emitter.pump_once()  # negotiated on first contact
+        assert _wait(lambda: server.telemetry_frames >= 1)
+        assert _wait(lambda: agg.peers == [PEER])
+        time.sleep(0.05)
+        assert emitter.pump_once()  # second frame: rate delta defined
+        assert _wait(lambda: server.telemetry_frames >= 2)
+        # remote heartbeats re-beaten into the learner's registry
+        ages = learner_obs.heartbeats.ages()
+        assert PEER in ages and f"{PEER}/actor-0" in ages
+    finally:
+        client.close()
+        server.stop()
+        actor_obs.close()
+        learner_obs.close()
+        learner_metrics.close()
+
+    recs = [json.loads(l) for l in open(jsonl)]
+    frames = [r for r in recs if f"peer/{PEER}/seq" in r]
+    assert len(frames) >= 2
+    assert frames[-1][f"peer/{PEER}/ctr/replay_adds"] == 8.0
+    assert frames[-1][f"peer/{PEER}/hist/infer_latency_ms"]["count"] == 1
+    assert f"peer/{PEER}/gauge/ingest_rate" in frames[-1]
+    assert f"peer/{PEER}/hb/actor-0" in frames[-1]
+    s = summarize(recs)
+    assert PEER in s["peers"]
+    text = format_report(s)
+    assert "fleet peers" in text and PEER in text
+    assert "ingest rate" in text and "heartbeat ages" in text
+
+
+# -- cross-process trace correlation ---------------------------------------
+
+def test_cross_process_trace_shares_batch_id(tmp_path):
+    """A transition batch's journey reconstructs as ONE trace: the
+    actor's ship event (replayed onto a peer/<id> track) and the
+    learner's ingest span carry the same batch_id."""
+    trace = str(tmp_path / "trace.json")
+    learner_obs = build_obs(
+        ObsConfig(enabled=True, trace_path=trace,
+                  heartbeat_timeout_s=0.0), Metrics())
+    server = SocketIngestServer("127.0.0.1", 0)
+    agg = FleetAggregator(learner_obs)
+    assert agg.install(server)
+
+    actor_obs = _actor_obs()
+    client = SocketTransport("127.0.0.1", server.port)
+    stamper = StampingTransport(client, PEER)
+    emitter = TelemetryEmitter(stamper, actor_obs, PEER, interval_s=0)
+    try:
+        stamper.send_experience(_experience_batch())
+        got = server.recv_experience(timeout=5.0)
+        assert got is not None
+        bid = int(got["batch_id"])
+        assert got["peer"] == PEER and bid == 0
+        # the driver's ingest path stamps this span (runtime/driver.py
+        # _ingest_one); here the learner half is written directly
+        with learner_obs.span("ingest.batch", batch_id=bid, peer=PEER,
+                              rows=8):
+            pass
+        assert emitter.pump_once()
+        assert _wait(lambda: server.telemetry_frames >= 1)
+    finally:
+        client.close()
+        server.stop()
+        actor_obs.close()
+        learner_obs.close()
+
+    evs = load_trace(trace)["traceEvents"]
+    ship = [e for e in evs if e.get("ph") == "X"
+            and e["name"] == "actor.ship"]
+    ingest = [e for e in evs if e.get("ph") == "X"
+              and e["name"] == "ingest.batch"]
+    assert ship and ingest
+    assert ship[0]["args"]["batch_id"] == ingest[0]["args"]["batch_id"]
+    assert ship[0]["args"]["peer"] == PEER
+    # the replayed span landed on a labeled synthetic peer track
+    tracks = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert f"peer/{PEER}" in tracks
+
+
+# -- remote stall attribution ----------------------------------------------
+
+def test_wedged_remote_actor_raises_attributed_stall():
+    """A peer whose frame reports a stale component heartbeat trips the
+    learner's LOCAL watchdog with the fleet-qualified name — a wedged
+    remote actor is a named StallError, not silence."""
+    obs = build_obs(ObsConfig(enabled=True, heartbeat_timeout_s=1.0),
+                    Metrics())
+    agg = FleetAggregator(obs)
+    agg.on_frame(PEER, {"peer": PEER, "seq": 0,
+                        "hb": {"actor-0": [5.0, "frame 9000"]}})
+    with pytest.raises(StallError) as ei:
+        obs.watchdog.check()
+    e = ei.value
+    assert e.component == f"{PEER}/actor-0"
+    assert e.staleness_s == pytest.approx(5.0, abs=0.5)
+    assert "frame 9000" in str(e)
+
+
+def test_disconnect_is_counted_and_attributed(tmp_path):
+    """Killing the actor host mid-run: the server names the peer, the
+    aggregator counts it, and the JSONL carries the attribution."""
+    jsonl = str(tmp_path / "run.jsonl")
+    metrics = Metrics(log_path=jsonl)
+    obs = build_obs(ObsConfig(enabled=True, heartbeat_timeout_s=0.0),
+                    metrics)
+    server = SocketIngestServer("127.0.0.1", 0)
+    agg = FleetAggregator(obs)
+    assert agg.install(server)
+    actor_obs = _actor_obs()
+    client = SocketTransport("127.0.0.1", server.port)
+    emitter = TelemetryEmitter(client, actor_obs, PEER, interval_s=0)
+    try:
+        assert emitter.pump_once()
+        assert _wait(lambda: server.telemetry_frames >= 1)
+        client.close()  # the "kill" — connection drops mid-run
+        assert _wait(lambda: server.peer_disconnects >= 1)
+        assert _wait(
+            lambda: obs.registry.counter("peer_disconnects").value >= 1)
+    finally:
+        server.stop()
+        actor_obs.close()
+        obs.close()
+        metrics.close()
+    recs = [json.loads(l) for l in open(jsonl)]
+    assert any(r.get("peer_disconnect") == PEER for r in recs)
+    s = summarize(recs)
+    assert s["disconnects"] and s["disconnects"][-1]["peer"] == PEER
+
+
+# -- negotiation fallbacks --------------------------------------------------
+
+def test_old_client_new_server_drops_telemetry_cleanly():
+    """telemetry=False models an old actor build: experience flows,
+    no frames are expected, and send_telemetry reports un-negotiated
+    instead of writing junk the server would fault on."""
+    server = SocketIngestServer("127.0.0.1", 0)
+    client = SocketTransport("127.0.0.1", server.port, telemetry=False)
+    try:
+        client.send_experience(_experience_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+        assert not client.telemetry_negotiated
+        assert client.send_telemetry({"peer": PEER, "seq": 0}) is False
+        assert client.telemetry_frames_out == 0
+        assert server.telemetry_frames == 0
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_new_client_old_server_degrades_to_no_telemetry():
+    """An old server never acks the hello: the client times out, keeps
+    raw experience flowing, and the emitter's pump reports unsent."""
+    listener = socket_mod.socket(socket_mod.AF_INET,
+                                 socket_mod.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    client = SocketTransport("127.0.0.1", listener.getsockname()[1],
+                             hello_timeout=0.3)
+    accepted = []
+
+    def accept():
+        conn, _ = listener.accept()
+        accepted.append(conn)  # accept, then say nothing (old build)
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    try:
+        actor_obs = _actor_obs()
+        emitter = TelemetryEmitter(client, actor_obs, PEER, interval_s=0)
+        assert emitter.pump_once() is False  # hello timed out: no grant
+        assert not client.telemetry_negotiated
+        assert client.negotiated_codec == "raw"
+        actor_obs.close()
+    finally:
+        client.close()
+        for c in accepted:
+            c.close()
+        listener.close()
