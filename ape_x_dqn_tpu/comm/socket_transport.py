@@ -63,6 +63,32 @@ A connection that carried at least one telemetry frame is an
 IDENTIFIED peer: its socket closing is attributed (peer_disconnects +
 a warning naming the peer + the on_disconnect hook) instead of being
 silent actor loss.
+
+MEMBERSHIP EPOCH (MSG_HELLO_ACK "epoch"): every server incarnation
+stamps a fresh epoch id into its hello ack, so a client can tell "the
+same learner blipped" from "a NEW learner took the address" (restart,
+upgrade, failover). The client's supervised reconnect loop (capped
+jittered exponential backoff, per-reason drop accounting) reruns the
+hello on every reconnect — codec and telemetry renegotiate for free —
+and an epoch CHANGE additionally resets the push cell and warns, so
+params re-converge to the live incarnation even when its version
+counter restarted below the old one. Old peers never see the field
+(an old client sends no hello; an old server sends no epoch) and keep
+the pre-epoch poll/raw behavior — no protocol break.
+
+PARAM VERSIONING (MSG_PARAMS header + MSG_PARAMS_PUSH): a new client's
+MSG_PARAMS_REQ carries the (epoch, version) it already has as a JSON
+payload; a new server answers MSG_PARAMS with a small
+[magic, epoch, version] header, followed by the pickled blob only when
+the client is actually behind — an up-to-date replica costs one
+header-sized round-trip instead of re-shipping megabytes of weights.
+Peers that negotiated "params_push" in the hello additionally receive
+server-initiated MSG_PARAMS_PUSH frames (same header+blob shape) on
+the experience socket at publish time, turning the param path from
+per-actor polling into epoch-versioned publication. An old server
+ignores the request payload and replies with the legacy raw pickle;
+an old client sends an empty request and gets exactly that — the
+param path interops both ways with pre-epoch builds.
 """
 
 from __future__ import annotations
@@ -71,11 +97,13 @@ import json
 import logging
 import pickle
 import queue
+import random
 import socket
 import struct
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -91,12 +119,21 @@ MSG_HELLO = 4          # client codec offer (JSON), sent on connect
 MSG_HELLO_ACK = 5      # server's codec choice (JSON)
 MSG_EXPERIENCE_C = 6   # experience payload with codec-encoded leaves
 MSG_TELEMETRY = 7      # per-peer obs snapshot frame (JSON), negotiated
+MSG_PARAMS_PUSH = 8    # server-initiated params (negotiated subscribers)
 
 WIRE_CODECS = ("raw", "delta-deflate")
 
 _HDR = struct.Struct("<IBIQ")  # magic, type, crc, payload_len
 MAX_PAYLOAD = 1 << 31
 _WARNED_BAD_BLOB = False
+# versioned params reply prefix: magic, membership epoch, version.
+# The magic cannot collide with a legacy reply — raw pickled blobs
+# start with pickle's 0x80 opcode — so a client can parse either shape
+# without knowing the server's build.
+_PARAMS_HDR = struct.Struct("<Iqq")
+PARAMS_HDR_MAGIC = 0x41505856  # 'APXV'
+# samples kept for the reconnect/recovery-latency instrument
+_RECONNECT_SAMPLES = 256
 
 # delta+deflate only pays on frame-sized rows; small rows (actions,
 # rewards) would spend more header than they save
@@ -529,7 +566,8 @@ class SocketIngestServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  max_pending: int = 64, idle_grace_s: float = 5.0,
                  param_wire_dtype: str = "bfloat16",
-                 wire_codec: str = "delta-deflate"):
+                 wire_codec: str = "delta-deflate",
+                 epoch: int | None = None):
         """param_wire_dtype: dtype for float params on the wire.
         "bfloat16" (default) halves the weight-broadcast bytes — the
         round-3 soak measured param pulls saturating a bandwidth-
@@ -543,13 +581,25 @@ class SocketIngestServer:
         the connect-time hello negotiation ("delta-deflate" default;
         "raw" is the escape hatch that forces every peer to plain
         payloads). Decode is always codec-capable — the setting only
-        controls what MSG_HELLO_ACK offers."""
+        controls what MSG_HELLO_ACK offers.
+
+        epoch: membership epoch id stamped into every MSG_HELLO_ACK
+        and versioned params header. Defaults to a wall-clock-derived
+        id, so a restarted server (a new incarnation at the same
+        address) presents a different epoch and clients re-converge;
+        pass an explicit value to pin it (tests, deterministic
+        fleets)."""
         if param_wire_dtype not in ("bfloat16", "float32"):
             raise ValueError(
                 f"param_wire_dtype must be 'bfloat16' or 'float32', "
                 f"got {param_wire_dtype!r}")
         self._wire_dtype = param_wire_dtype
         self._codec = _check_codec(wire_codec)
+        # membership epoch: wall-clock-derived by default so a restarted
+        # incarnation at the same address stamps a DIFFERENT id (tests
+        # pin it; collisions need two restarts in the same millisecond)
+        self.epoch = (int(epoch) if epoch is not None
+                      else (time.time_ns() // 1_000_000) & 0x7FFF_FFFF)
         self._q: queue.Queue[dict] = queue.Queue(maxsize=max_pending)
         self._dropped = 0  # guarded-by: _conns_lock
         # wire accounting (payload bytes; headers are ~17B noise):
@@ -589,8 +639,24 @@ class SocketIngestServer:
         # threads, so implementations must be thread-safe
         self.on_telemetry: Any = None  # (peer_id: str, frame: dict) -> None
         self.on_disconnect: Any = None  # (peer_id: str) -> None
+        # byzantine-peer accounting: a truncated/garbled frame is an
+        # attributed counter + hook call, not just a silently-ended
+        # connection (a corrupting proxy or skewed build would
+        # otherwise churn connections with no observable trace)
+        self.on_decode_error: Any = None  # (peer_id: str, reason: str) -> None
+        self._wire_decode_errors = 0  # guarded-by: _conns_lock
         self._last_disconnect: float | None = None  # guarded-by: _conns_lock
         self._ever_connected = False  # guarded-by: _conns_lock
+        # params-push plane: subscribers registered at hello time; a
+        # dedicated thread ships versioned blobs at publish boundaries
+        # so a slow subscriber's sendall never runs on the learner
+        # thread. Per-connection send locks serialize the reader's
+        # replies (acks, poll responses) against push writes.
+        self._push_subs: dict[int, socket.socket] = {}  # guarded-by: _conns_lock
+        self._conn_send_locks: dict[int, Any] = {}  # guarded-by: _conns_lock
+        self._param_pushes = 0  # guarded-by: _conns_lock
+        self._push_wake = threading.Event()
+        self._push_thread: threading.Thread | None = None
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ingest-accept", daemon=True)
         self._accept_thread.start()
@@ -629,17 +695,50 @@ class SocketIngestServer:
             self._params = (params, version)
             self._params_blob = None
             self._params_cache = None
+        # wake the push thread (no-op when nothing ever subscribed)
+        self._push_wake.set()
+
+    def bump_epoch(self) -> None:
+        """Advance the membership epoch in place — the drill/test hook
+        for 'a new incarnation took over' without tearing the listener
+        down. New hellos and versioned param replies carry the new id;
+        connected epoch-aware clients converge on their next exchange."""
+        self.epoch += 1
+        self._push_wake.set()
+
+    def _build_blob_locked(self) -> bytes:
+        """(Re)build the pickled param blob; caller holds self._lock.
+        Split out of _param_blob so the versioned reply path can read
+        (blob, version) ATOMICALLY — pairing a blob with the version of
+        a concurrent publish would let an up-to-date client skip a real
+        update."""
+        if self._params_blob is None:
+            params, version = self._params
+            host = jax_to_numpy(params)
+            if self._wire_dtype == "bfloat16":
+                host = _downcast_f32(host)
+            self._params_blob = pickle.dumps(  # apexlint: unguarded(caller holds _lock)
+                (host, version), protocol=pickle.HIGHEST_PROTOCOL)
+        return self._params_blob
 
     def _param_blob(self) -> bytes:
         with self._lock:
-            if self._params_blob is None:
-                params, version = self._params
-                host = jax_to_numpy(params)
-                if self._wire_dtype == "bfloat16":
-                    host = _downcast_f32(host)
-                self._params_blob = pickle.dumps(
-                    (host, version), protocol=pickle.HIGHEST_PROTOCOL)
-            return self._params_blob
+            return self._build_blob_locked()
+
+    def _versioned_params_reply(self, have_epoch: int,
+                                have_version: int) -> bytes:
+        """Versioned MSG_PARAMS/MSG_PARAMS_PUSH payload:
+        [magic, epoch, version] header, plus the pickled blob only when
+        the client's (epoch, version) is behind — an up-to-date replica
+        costs a header-sized reply instead of megabytes of weights."""
+        epoch = self.epoch
+        with self._lock:
+            blob = self._build_blob_locked()
+            version = self._params[1]
+        hdr = _PARAMS_HDR.pack(PARAMS_HDR_MAGIC, epoch, version)
+        if have_epoch == epoch and have_version == version:
+            return hdr
+        return hdr + blob
 
     def get_params(self) -> tuple[Any, int]:
         """Local loopback callers get the deserialized tree directly,
@@ -707,6 +806,25 @@ class SocketIngestServer:
             return self._peer_disconnects
 
     @property
+    def wire_decode_errors(self) -> int:
+        """Truncated/garbled/misframed frames received (each one also
+        dropped its connection and fired on_decode_error)."""
+        with self._conns_lock:
+            return self._wire_decode_errors
+
+    @property
+    def param_pushes(self) -> int:
+        """MSG_PARAMS_PUSH frames shipped to subscribed peers."""
+        with self._conns_lock:
+            return self._param_pushes
+
+    @property
+    def push_subscribers(self) -> int:
+        """Connections that negotiated params_push and are still up."""
+        with self._conns_lock:
+            return len(self._push_subs)
+
+    @property
     def pending(self) -> int:
         return self._q.qsize()
 
@@ -736,7 +854,17 @@ class SocketIngestServer:
         send inside the same call, so an actor host that blipped is
         back within milliseconds — an idle verdict taken in that window
         would terminate a multihost fleet whose producers all intend to
-        return (round-2 advisor finding on local_idle)."""
+        return (round-2 advisor finding on local_idle).
+
+        INVARIANT vs the supervised reconnect loop: the client's
+        reconnect backoff cap (CommConfig.reconnect_cap_s, 2.0 default)
+        must stay BELOW idle_grace_s (5.0 default). A client backing
+        off from a connection this server dropped retries — and, with
+        the server healthy, reconnects — within one cap interval, well
+        inside the grace window that its own disconnect opened, so a
+        fleet merely riding out a blip never reads as quiesced. Stretch
+        the backoff cap past the grace and the debounce breaks; tests
+        pin the ordering (test_chaos.py)."""
         with self._conns_lock:
             if self._conns:
                 return False
@@ -747,13 +875,16 @@ class SocketIngestServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._push_wake.set()  # unblock the push thread's wait
         self._accept_thread.join(timeout=2)
+        if self._push_thread is not None:
+            self._push_thread.join(timeout=2)
         with self._conns_lock:
             conns = list(self._conns)
         for c in conns:
             try:
                 c.close()
-            except OSError:
+            except OSError:  # apexlint: lossy(shutdown close best effort)
                 pass
         self._listener.close()
 
@@ -763,15 +894,71 @@ class SocketIngestServer:
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
-            except socket.timeout:
+            except socket.timeout:  # apexlint: lossy(idle accept tick, nothing lost)
                 continue
-            except OSError:
+            except OSError:  # apexlint: lossy(listener closed by stop())
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conns_lock:
                 self._conns.append(conn)
+                self._conn_send_locks[id(conn)] = make_lock(
+                    "ingest_server.conn_send")
             threading.Thread(target=self._reader, args=(conn,),
                              name="ingest-reader", daemon=True).start()
+
+    def _send_on(self, conn: socket.socket, mtype: int,
+                 payload: bytes) -> None:
+        """Send one frame on a connection, serialized against the other
+        writer (the reader's replies vs the push thread). The per-conn
+        lock is fetched under _conns_lock but HELD WITHOUT it — a slow
+        subscriber's sendall must never stall accept/disconnect
+        bookkeeping for the whole fleet."""
+        with self._conns_lock:
+            lock = self._conn_send_locks.get(id(conn))
+        if lock is None:  # connection already torn down
+            raise OSError("connection closed")
+        with lock:
+            _send_msg(conn, mtype, payload)
+
+    def _ensure_push_thread(self) -> None:
+        """Lazily start the push thread on the first subscription —
+        poll-only fleets (and every pre-push build's usage) never pay
+        for it."""
+        with self._conns_lock:
+            if self._push_thread is not None or self._stop.is_set():
+                return
+            self._push_thread = threading.Thread(
+                target=self._push_loop, name="params-push", daemon=True)
+            self._push_thread.start()
+
+    def _push_loop(self) -> None:
+        """Ship versioned param frames to subscribers at publish/epoch
+        boundaries. Dedupe on (epoch, version) so spurious wakes cost
+        nothing; a subscriber whose send fails is dropped from the set
+        (its reader teardown handles the rest)."""
+        sent: tuple[int, int] | None = None
+        while not self._stop.is_set():
+            if not self._push_wake.wait(timeout=0.2):
+                continue
+            self._push_wake.clear()
+            with self._lock:
+                version = self._params[1]
+            cur = (self.epoch, version)
+            if cur == sent or version < 0:
+                continue
+            payload = self._versioned_params_reply(-1, -1)
+            sent = cur
+            with self._conns_lock:
+                subs = list(self._push_subs.values())
+            for conn in subs:
+                try:
+                    self._send_on(conn, MSG_PARAMS_PUSH, payload)
+                    with self._conns_lock:
+                        self._param_pushes += 1
+                        self._bytes_out += len(payload)
+                except OSError:  # apexlint: lossy(subscriber dropped; reader attributes the disconnect)
+                    with self._conns_lock:
+                        self._push_subs.pop(id(conn), None)
 
     def _reader(self, conn: socket.socket) -> None:
         try:
@@ -822,15 +1009,25 @@ class SocketIngestServer:
                         hello = json.loads(bytes(payload))
                         offered = hello.get("codecs", [])
                         wants_tel = bool(hello.get("telemetry"))
+                        wants_push = bool(hello.get("params_push"))
                     except (ValueError, AttributeError):
-                        offered, wants_tel = [], False
+                        offered, wants_tel, wants_push = [], False, False
                     grant = self._codec if self._codec in offered \
                         else "raw"
-                    ack: dict[str, Any] = {"codec": grant}
+                    # the epoch rides every ack: an old client never
+                    # hellos (never sees it), a new client uses it to
+                    # distinguish a blip from a new incarnation
+                    ack: dict[str, Any] = {"codec": grant,
+                                           "epoch": self.epoch}
                     if wants_tel:
                         ack["telemetry"] = True
-                    _send_msg(conn, MSG_HELLO_ACK,
-                              json.dumps(ack).encode())
+                    if wants_push:
+                        ack["params_push"] = True
+                        with self._conns_lock:
+                            self._push_subs[id(conn)] = conn
+                        self._ensure_push_thread()
+                    self._send_on(conn, MSG_HELLO_ACK,
+                                  json.dumps(ack).encode())
                 elif mtype == MSG_TELEMETRY:
                     # per-peer obs snapshot: remember which peer this
                     # connection is (disconnect attribution), count the
@@ -849,18 +1046,48 @@ class SocketIngestServer:
                     if cb is not None:
                         cb(peer, frame)
                 elif mtype == MSG_PARAMS_REQ:
-                    blob = self._param_blob()
+                    # empty payload = legacy client: raw pickled blob.
+                    # JSON payload = epoch-aware client stating what it
+                    # already has: versioned header, blob only if behind.
+                    if len(payload) == 0:
+                        reply = self._param_blob()
+                    else:
+                        try:
+                            req = json.loads(bytes(payload))
+                            have_ep = int(req.get("epoch", -1))
+                            have_v = int(req.get("v", -1))
+                        except (ValueError, AttributeError, TypeError):
+                            have_ep, have_v = -1, -1
+                        reply = self._versioned_params_reply(
+                            have_ep, have_v)
                     with self._conns_lock:
-                        self._bytes_out += len(blob)
-                    _send_msg(conn, MSG_PARAMS, blob)
-        except (OSError, ValueError):
-            return  # dead/corrupt connection: drop it, keep serving others
+                        self._bytes_out += len(reply)
+                    self._send_on(conn, MSG_PARAMS, reply)
+        except OSError:
+            # dead connection: drop it, keep serving others — the loss
+            # is accounted where it is attributable (peer_disconnects
+            # in the finally path below)
+            return  # apexlint: lossy(disconnect counted in reader finally)
+        except ValueError as e:
+            # truncated / garbled / misframed traffic: the connection
+            # still drops (framing state is unrecoverable mid-stream),
+            # but the fault is COUNTED and attributed so a byzantine or
+            # proxied peer can't silently churn connections
+            with self._conns_lock:
+                self._wire_decode_errors += 1
+                who = self._conn_peers.get(id(conn), "unidentified")
+            cb = self.on_decode_error
+            if cb is not None and not self._stop.is_set():
+                cb(who, str(e))
+            return
         finally:
             with self._conns_lock:
                 try:
                     self._conns.remove(conn)  # churn must not leak socks
                 except ValueError:
                     pass
+                self._conn_send_locks.pop(id(conn), None)
+                self._push_subs.pop(id(conn), None)
                 self._last_disconnect = time.monotonic()
                 peer = self._conn_peers.pop(id(conn), None)
                 if peer is not None:
@@ -875,7 +1102,7 @@ class SocketIngestServer:
                     cb(peer)
             try:
                 conn.close()
-            except OSError:
+            except OSError:  # apexlint: lossy(close of dead connection)
                 pass
 
 
@@ -933,31 +1160,61 @@ class SocketTransport:
     """Transport for a remote actor host: pushes experience, pulls params.
 
     send_experience never raises into the actor loop: on a broken
-    connection it attempts one reconnect and otherwise counts the batch
-    as dropped (Ape-X ingest is lossy-tolerant; the actor keeps
-    generating experience for when the learner returns).
+    connection it runs a SUPERVISED RECONNECT LOOP — one immediate
+    retry inside the failing call, then capped jittered exponential
+    backoff across calls (reconnect_base_s doubling to reconnect_cap_s,
+    full jitter so a restarted learner is not hit by the whole fleet at
+    once). Batches that fall in a backoff window are dropped without
+    touching the network; every drop is accounted by reason
+    (refused / reset / timeout / backpressure / other) so a soak can
+    tell a dead learner from a saturated link (Ape-X ingest is
+    lossy-tolerant; the actor keeps generating experience for when the
+    learner returns).
 
     wire_codec is OFFERED at connect time (MSG_HELLO) and used only if
     the server acks it; an old server ignores the hello, the ack read
     times out (hello_timeout), and the connection falls back to raw —
     negotiation reruns on every reconnect, so a learner restart onto a
-    different build renegotiates transparently.
+    different build renegotiates transparently. The ack also carries
+    the server's membership epoch: an epoch CHANGE (new incarnation)
+    resets the pushed-params cell and is counted/logged, so the param
+    path re-converges even when the new learner's version counter
+    restarted below the old one.
     """
 
     def __init__(self, host: str, port: int, connect_timeout: float = 10.0,
                  wire_codec: str = "delta-deflate",
-                 hello_timeout: float = 2.0, telemetry: bool = True):
+                 hello_timeout: float = 2.0, telemetry: bool = True,
+                 reconnect_base_s: float = 0.05,
+                 reconnect_cap_s: float = 2.0,
+                 params_push: bool = False):
         """telemetry: offer the fleet-telemetry capability in the
         connect-time hello. send_telemetry only ships frames after the
         server granted it, so leaving this on against an old server
-        costs one hello-timeout per (re)connect and nothing after."""
+        costs one hello-timeout per (re)connect and nothing after.
+
+        reconnect_base_s/reconnect_cap_s: supervised-reconnect backoff
+        window. The cap must stay below the server's idle_grace_s (see
+        SocketIngestServer.quiesced) so a backing-off fleet never reads
+        as quiesced.
+
+        params_push: offer the server-initiated param publication
+        capability; when granted, MSG_PARAMS_PUSH frames arrive on the
+        experience socket and poll_pushed_params() hands them over —
+        against an old server the offer is ignored and polling is the
+        only path."""
         self._addr = (host, port)
         self._timeout = connect_timeout
         self._codec = _check_codec(wire_codec)
         self._hello_timeout = hello_timeout
         self._telemetry = bool(telemetry)
+        self._params_push = bool(params_push)
+        self._reconnect_base_s = max(float(reconnect_base_s), 1e-3)
+        self._reconnect_cap_s = max(float(reconnect_cap_s),
+                                    self._reconnect_base_s)
         self._negotiated: str = "raw"  # guarded-by: _send_lock
         self._telemetry_ok = False  # guarded-by: _send_lock
+        self._push_ok = False  # guarded-by: _send_lock
         self._telemetry_frames_out = 0  # guarded-by: _send_lock
         self._telemetry_bytes_out = 0  # guarded-by: _send_lock
         self._sock: socket.socket | None = None  # guarded-by: _send_lock
@@ -966,7 +1223,31 @@ class SocketTransport:
         self._bytes_out = 0  # guarded-by: _send_lock
         self._raw_bytes_out = 0  # guarded-by: _send_lock
         self._encode_ms = 0.0  # guarded-by: _send_lock
+        # supervised-reconnect state (all guarded-by: _send_lock):
+        # consecutive failures drive the exponential backoff; the
+        # disconnect timestamp feeds the reconnect-latency instrument
+        self._consec_fails = 0  # guarded-by: _send_lock
+        self._backoff_until = 0.0  # guarded-by: _send_lock
+        self._reconnects = 0  # guarded-by: _send_lock
+        self._disconnected_at: float | None = None  # guarded-by: _send_lock
+        self._reconnect_latencies: deque[float] = deque(
+            maxlen=_RECONNECT_SAMPLES)  # guarded-by: _send_lock
+        self._drop_reasons = {"refused": 0, "reset": 0, "timeout": 0,
+                              "backpressure": 0, "other": 0}  # guarded-by: _send_lock
         self._bytes_in = 0  # guarded-by: _param_lock
+        self._param_version = -1  # guarded-by: _param_lock
+        self._param_epoch = -1  # guarded-by: _param_lock
+        self._param_pull_errors = 0  # guarded-by: _param_lock
+        self._param_unchanged = 0  # guarded-by: _param_lock
+        # membership epoch as last seen from any server message; its
+        # own lock because both the send path (hello ack) and the param
+        # path (versioned replies) update it
+        self._epoch = -1  # guarded-by: _meta_lock
+        self._epoch_changes = 0  # guarded-by: _meta_lock
+        # server-pushed params land here (reader thread) until the
+        # puller consumes them via poll_pushed_params
+        self._pushed: tuple[Any, int, int] | None = None  # guarded-by: _push_lock
+        self._param_pushes_in = 0  # guarded-by: _push_lock
         # independent locks: a param pull blocking on the network (up to
         # the connect timeout) must not stall the actor threads' experience
         # sends — they use different sockets and share no state.
@@ -975,27 +1256,101 @@ class SocketTransport:
         # bytes pulled — the soak's link-budget accounting)
         self._send_lock = make_lock("transport._send_lock")
         self._param_lock = make_lock("transport._param_lock")
+        self._meta_lock = make_lock("transport._meta_lock")
+        self._push_lock = make_lock("transport._push_lock")
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
+    @staticmethod
+    def _classify_drop(exc: BaseException) -> str:
+        """Per-reason drop accounting bucket for a send/connect failure.
+        socket.timeout is TimeoutError is an OSError subclass — test
+        the narrow classes before the broad one."""
+        if isinstance(exc, ConnectionRefusedError):
+            return "refused"
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError)):
+            return "reset"
+        if isinstance(exc, (socket.timeout, TimeoutError)):
+            return "timeout"
+        return "other"
+
+    def _note_send_failure(self, exc: BaseException) -> str:
+        """Record one failed send/connect on the experience path and
+        arm the backoff window (caller holds _send_lock). Exponential
+        with FULL jitter: a fleet of actors that lost the same learner
+        decorrelates instead of reconnect-storming the restarted one.
+        Returns the drop-reason bucket."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # apexlint: lossy(close of an already-dead socket)
+                pass
+            self._sock = None  # apexlint: unguarded(caller holds _send_lock)
+        if self._disconnected_at is None:
+            self._disconnected_at = time.monotonic()  # apexlint: unguarded(caller holds _send_lock)
+        self._consec_fails += 1  # apexlint: unguarded(caller holds _send_lock)
+        backoff = min(self._reconnect_cap_s,
+                      self._reconnect_base_s
+                      * (2 ** min(self._consec_fails - 1, 16)))
+        self._backoff_until = (time.monotonic()  # apexlint: unguarded(caller holds _send_lock)
+                               + backoff * (0.5 + 0.5 * random.random()))
+        return self._classify_drop(exc)
+
+    def _note_connected(self) -> None:
+        """Reset the backoff after a successful (re)connect and sample
+        the outage length into the recovery-latency instrument (caller
+        holds _send_lock)."""
+        self._consec_fails = 0  # apexlint: unguarded(caller holds _send_lock)
+        self._backoff_until = 0.0  # apexlint: unguarded(caller holds _send_lock)
+        if self._disconnected_at is not None:
+            self._reconnect_latencies.append(
+                time.monotonic() - self._disconnected_at)
+            self._disconnected_at = None  # apexlint: unguarded(caller holds _send_lock)
+            self._reconnects += 1  # apexlint: unguarded(caller holds _send_lock)
+
+    def _note_epoch(self, ep: int) -> None:
+        """Record the server epoch from an ack / versioned reply; an
+        epoch CHANGE (new server incarnation) clears the pushed-params
+        cell (it came from the dead incarnation) and warns — version
+        counters may have restarted, so downstream updates must key on
+        the epoch, not on version monotonicity."""
+        with self._meta_lock:
+            old = self._epoch
+            self._epoch = ep
+            changed = old != -1 and old != ep
+            if changed:
+                self._epoch_changes += 1
+        if changed:
+            with self._push_lock:
+                self._pushed = None
+            logging.getLogger(__name__).warning(
+                "[fleet] learner epoch changed %d -> %d (restart or "
+                "failover); params will re-converge to the new "
+                "incarnation", old, ep)
+
     def _connect_experience(self) -> socket.socket:
-        """Connect the experience socket and negotiate the wire codec.
-        Sets self._negotiated; any failure mode (old server ignoring
-        the hello, timeout, garbled ack) degrades to raw, never to an
-        error — raw MSG_EXPERIENCE is universally understood."""
+        """Connect the experience socket and negotiate codec, telemetry
+        and params-push. Sets self._negotiated; any failure mode (old
+        server ignoring the hello, timeout, garbled ack) degrades to
+        raw, never to an error — raw MSG_EXPERIENCE is universally
+        understood."""
         sock = self._connect()
         # only send_experience/send_telemetry call this, under _send_lock
         self._negotiated = "raw"  # apexlint: unguarded(caller holds _send_lock)
         self._telemetry_ok = False  # apexlint: unguarded(caller holds _send_lock)
-        if self._codec != "raw" or self._telemetry:
+        self._push_ok = False  # apexlint: unguarded(caller holds _send_lock)
+        if self._codec != "raw" or self._telemetry or self._params_push:
             # the hello now also fires with a raw codec when telemetry
             # is wanted — an old server still just ignores it
             try:
                 offer = {"codecs": [self._codec],
                          "telemetry": self._telemetry}
+                if self._params_push:
+                    offer["params_push"] = True
                 _send_msg(sock, MSG_HELLO, json.dumps(offer).encode())
                 sock.settimeout(self._hello_timeout)
                 msg = _recv_msg(sock)
@@ -1006,19 +1361,129 @@ class SocketTransport:
                         self._negotiated = grant  # apexlint: unguarded(caller holds _send_lock)
                     if self._telemetry and bool(ack.get("telemetry")):
                         self._telemetry_ok = True  # apexlint: unguarded(caller holds _send_lock)
+                    if self._params_push and bool(ack.get("params_push")):
+                        self._push_ok = True  # apexlint: unguarded(caller holds _send_lock)
+                    ep = ack.get("epoch")
+                    if isinstance(ep, int):
+                        self._note_epoch(ep)
             except (OSError, ValueError, AttributeError):
-                pass  # old server / timeout / garbage ack -> raw
+                pass  # apexlint: lossy(old server / timeout / garbage ack -> raw fallback)
             finally:
                 sock.settimeout(self._timeout)
+        self._note_connected()
+        if self._push_ok:
+            threading.Thread(target=self._push_reader, args=(sock,),
+                             name="params-push-reader",
+                             daemon=True).start()
         return sock
+
+    def _push_reader(self, sock: socket.socket) -> None:
+        """Reader for server-initiated MSG_PARAMS_PUSH frames on the
+        experience socket; one thread per negotiated connection, exits
+        when that socket dies (the next reconnect spawns a fresh one).
+        Waits on select so an idle socket never trips the IO timeout
+        mid-frame; once bytes are available, a timeout inside the frame
+        read means a wedged sender and drops the connection."""
+        import select
+        while True:
+            try:
+                ready, _, _ = select.select([sock], [], [], 0.25)
+                if not ready:
+                    if sock.fileno() < 0:
+                        return
+                    continue
+                msg = _recv_msg(sock)
+            except (OSError, ValueError):  # apexlint: lossy(push reader exits; reconnect respawns it)
+                return
+            if msg is None:
+                return
+            if msg[0] != MSG_PARAMS_PUSH:
+                continue  # unexpected control traffic: ignore
+            parsed = self._parse_params_payload(msg[1])
+            if parsed is None:
+                continue
+            _, params, version, ep = parsed
+            if ep is None:
+                continue  # push frames are always versioned
+            self._note_epoch(ep)
+            if params is not None:
+                with self._push_lock:
+                    self._pushed = (params, version, ep)
+                    self._param_pushes_in += 1
+            # the poll path now knows this (epoch, version) is in hand,
+            # so its next conditional pull is a header-sized round-trip
+            with self._param_lock:
+                self._param_epoch = ep
+                self._param_version = version
+
+    def poll_pushed_params(self) -> tuple[Any, int]:
+        """Consume the latest server-pushed params, if any arrived
+        since the last call: (params, version), or (None, -1). Never
+        blocks; safe alongside get_params polling (the push cell is
+        epoch-cleared on incarnation change)."""
+        with self._push_lock:
+            cell, self._pushed = self._pushed, None
+        if cell is None:
+            return None, -1
+        return cell[0], cell[1]
+
+    def _parse_params_payload(self, payload) -> \
+            tuple[str, Any, int, int | None] | None:
+        """Parse a MSG_PARAMS / MSG_PARAMS_PUSH payload of either
+        shape: ("unchanged"|"full", params, version, epoch|None), or
+        None when the blob is undecodable. A versioned reply leads with
+        PARAMS_HDR_MAGIC; a legacy raw pickle cannot (pickle streams
+        start with the 0x80 opcode), so the sniff is unambiguous."""
+        if len(payload) >= _PARAMS_HDR.size:
+            magic, ep, ver = _PARAMS_HDR.unpack_from(payload)
+            if magic == PARAMS_HDR_MAGIC:
+                if len(payload) == _PARAMS_HDR.size:
+                    return "unchanged", None, ver, ep
+                try:
+                    params, version = pickle.loads(
+                        memoryview(payload)[_PARAMS_HDR.size:])
+                except Exception as e:
+                    self._warn_bad_blob(e)
+                    return None
+                return "full", _upcast_bf16(params), version, ep
+        try:
+            params, version = pickle.loads(payload)
+        except Exception as e:
+            self._warn_bad_blob(e)
+            return None
+        return "full", _upcast_bf16(params), version, None
+
+    @staticmethod
+    def _warn_bad_blob(e: BaseException) -> None:
+        # an undecodable blob usually means wire-format skew (e.g. a
+        # learner host on a newer build): swallowing it silently would
+        # leave the actor on stale params forever with a
+        # healthy-looking connection — log once per process
+        global _WARNED_BAD_BLOB
+        if not _WARNED_BAD_BLOB:
+            _WARNED_BAD_BLOB = True
+            logging.getLogger(__name__).warning(
+                "param blob undecodable (%r) — version skew between "
+                "actor and learner hosts? Actor continues on its "
+                "current params.", e)
 
     def send_experience(self, batch: dict) -> None:
         # encode under the send lock: the payload's codec must match
         # THIS connection's negotiation, which a mid-call reconnect can
         # change (it re-encodes in that case — reconnects are rare)
         with self._send_lock:
+            # backoff gate: inside a backoff window the batch drops
+            # WITHOUT touching the network — hammering a dead learner
+            # from every actor thread at full send rate is how
+            # reconnect storms start
+            if self._sock is None \
+                    and time.monotonic() < self._backoff_until:
+                self._dropped += 1
+                self._drop_reasons["backpressure"] += 1
+                return
             payload: bytes | None = None
             payload_codec: str | None = None
+            reason = "other"
             for _ in range(2):  # current socket, then one reconnect
                 try:
                     if self._sock is None:
@@ -1037,22 +1502,21 @@ class SocketTransport:
                         v.nbytes for v in batch.values()
                         if isinstance(v, np.ndarray))
                     return
-                except OSError:
-                    if self._sock is not None:
-                        try:
-                            self._sock.close()
-                        except OSError:
-                            pass
-                    self._sock = None
+                except OSError as e:
+                    reason = self._note_send_failure(e)
             self._dropped += 1
+            self._drop_reasons[reason] += 1
 
     def send_telemetry(self, frame: dict) -> bool:
         """Best-effort ship of one obs snapshot frame (MSG_TELEMETRY,
         JSON). Returns False — never raises into the pump thread — when
         the server did not grant telemetry (old build), the connection
-        is down and cannot be (re)established, or the send fails; the
-        caller simply tries again at its next cadence."""
+        is down or backing off, or the send fails; the caller simply
+        tries again at its next cadence."""
         with self._send_lock:
+            if self._sock is None \
+                    and time.monotonic() < self._backoff_until:
+                return False  # backoff window: don't probe the learner
             try:
                 if self._sock is None:
                     self._sock = self._connect_experience()
@@ -1063,13 +1527,8 @@ class SocketTransport:
                 self._telemetry_frames_out += 1
                 self._telemetry_bytes_out += len(payload)
                 return True
-            except OSError:
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                self._sock = None
+            except OSError as e:
+                self._note_send_failure(e)
                 return False
 
     def recv_experience(self, timeout: float | None = None) -> dict | None:
@@ -1079,11 +1538,22 @@ class SocketTransport:
         raise RuntimeError("actor-side transport cannot publish params")
 
     def get_params(self) -> tuple[Any, int]:
+        """Pull params, CONDITIONALLY when the server is epoch-aware:
+        the request states the (epoch, version) already in hand, and an
+        up-to-date puller gets back a header-sized "unchanged" reply —
+        (None, current_version) — instead of megabytes of weights. An
+        old server ignores the request payload and replies the legacy
+        raw pickle, which parses through the same path (epoch stays
+        unknown, every pull ships the full blob). Any failure returns
+        (None, -1) and bumps param_pull_errors; it never raises into
+        the puller thread."""
         with self._param_lock:
+            req = json.dumps({"v": self._param_version,
+                              "epoch": self._param_epoch}).encode()
             try:
                 if self._param_sock is None:
                     self._param_sock = self._connect()
-                _send_msg(self._param_sock, MSG_PARAMS_REQ, b"")
+                _send_msg(self._param_sock, MSG_PARAMS_REQ, req)
                 msg = _recv_msg(self._param_sock)
                 # a corrupt/misframed reply (ValueError from _recv_msg, or
                 # an unexpected type) is treated like a dead connection:
@@ -1092,40 +1562,112 @@ class SocketTransport:
                 if msg is not None and msg[0] != MSG_PARAMS:
                     raise ValueError(f"unexpected reply type {msg[0]}")
             except (OSError, ValueError):
-                msg = None
+                msg = None  # apexlint: lossy(counted as param_pull_errors just below)
             if msg is None:
+                self._param_pull_errors += 1
                 if self._param_sock is not None:
                     try:
                         self._param_sock.close()
-                    except OSError:
+                    except OSError:  # apexlint: lossy(close of an already-dead socket)
                         pass
                 self._param_sock = None
                 return None, -1
-        try:
-            # the blob decode below deliberately runs outside
-            # _param_lock; re-take it for the counter bump alone
+            self._bytes_in += len(msg[1])
+        # the blob decode deliberately runs outside _param_lock (it can
+        # be hundreds of ms for a big tree); re-take the lock only for
+        # the state updates
+        parsed = self._parse_params_payload(msg[1])
+        if parsed is None:
             with self._param_lock:
-                self._bytes_in += len(msg[1])
-            params, version = pickle.loads(msg[1])
-            return _upcast_bf16(params), version
-        except Exception as e:
-            # an undecodable blob usually means wire-format skew (e.g. a
-            # learner host on a newer build): swallowing it silently
-            # would leave the actor on stale params forever with a
-            # healthy-looking connection — log once per process
-            global _WARNED_BAD_BLOB
-            if not _WARNED_BAD_BLOB:
-                _WARNED_BAD_BLOB = True
-                import logging
-                logging.getLogger(__name__).warning(
-                    "param blob undecodable (%r) — version skew between "
-                    "actor and learner hosts? Actor continues on its "
-                    "current params.", e)
+                self._param_pull_errors += 1
             return None, -1
+        status, params, version, ep = parsed
+        if ep is not None:
+            self._note_epoch(ep)
+        with self._param_lock:
+            if ep is not None:
+                self._param_epoch = ep
+                self._param_version = version
+            if status == "unchanged":
+                self._param_unchanged += 1
+        if status == "unchanged":
+            return None, version
+        return params, version
 
     @property
     def dropped(self) -> int:
         return self._dropped
+
+    @property
+    def drop_reasons(self) -> dict[str, int]:
+        """Per-reason breakdown of dropped experience batches:
+        refused / reset / timeout / backpressure (dropped inside a
+        backoff window without touching the network) / other. Sums to
+        `dropped` for drops on the experience path."""
+        with self._send_lock:
+            return dict(self._drop_reasons)
+
+    @property
+    def reconnects(self) -> int:
+        """Successful experience-socket reconnects after an outage."""
+        with self._send_lock:
+            return self._reconnects
+
+    @property
+    def reconnect_latencies(self) -> list[float]:
+        """Outage lengths (seconds, first failure -> reconnect) for the
+        last _RECONNECT_SAMPLES recoveries — the chaos lane's
+        recovery-latency instrument."""
+        with self._send_lock:
+            return list(self._reconnect_latencies)
+
+    @property
+    def epoch(self) -> int:
+        """Server membership epoch last seen (-1 before any epoch-aware
+        exchange, e.g. against a pre-epoch server)."""
+        with self._meta_lock:
+            return self._epoch
+
+    @property
+    def epoch_changes(self) -> int:
+        """Times the server's epoch CHANGED under us (learner restart
+        or failover observed by this transport)."""
+        with self._meta_lock:
+            return self._epoch_changes
+
+    @property
+    def param_epoch(self) -> int:
+        """Epoch the currently-held params came from (-1 when unknown;
+        pullers key force-updates on changes of this, since a new
+        incarnation's version counter may restart below the old one)."""
+        with self._param_lock:
+            return self._param_epoch
+
+    @property
+    def param_pull_errors(self) -> int:
+        """get_params failures (connect/IO/decode) survived without
+        raising into the puller thread."""
+        with self._param_lock:
+            return self._param_pull_errors
+
+    @property
+    def param_unchanged(self) -> int:
+        """Conditional pulls answered with a header-only "unchanged"
+        reply (bytes the versioned param path saved shipping)."""
+        with self._param_lock:
+            return self._param_unchanged
+
+    @property
+    def params_push_negotiated(self) -> bool:
+        """True iff the current connection's hello/ack granted
+        server-initiated param publication."""
+        return self._push_ok
+
+    @property
+    def param_pushes_in(self) -> int:
+        """MSG_PARAMS_PUSH frames received from the learner."""
+        with self._push_lock:
+            return self._param_pushes_in
 
     @property
     def bytes_out(self) -> int:
@@ -1187,6 +1729,6 @@ class SocketTransport:
                 if s is not None:
                     try:
                         s.close()
-                    except OSError:
+                    except OSError:  # apexlint: lossy(close best effort)
                         pass
             self._sock = self._param_sock = None

@@ -166,6 +166,20 @@ class ActorConfig:
     # this many times per actor slot; Ape-X tolerates actor loss, so a
     # restart costs only the crashed actor's in-flight transitions
     max_restarts: int = 2
+    # Fleet supervisor (runtime/driver._supervise_tick): when obs
+    # heartbeats flag a LOCAL actor thread as stalled past the
+    # watchdog timeout, restart its slot in place (fresh env + actor,
+    # remaining frame budget) instead of raising StallError for the
+    # whole run. Each slot gets supervisor_max_restarts supervised
+    # restarts; past the budget the slot is QUARANTINED — heartbeat
+    # cleared, actor_quarantines counter + attributed JSONL event —
+    # and the run continues degraded, never a crash loop. Stalls of
+    # the learner/ingest/inference-server still raise (a driver
+    # cannot restart its own learner), and remote-peer stalls are
+    # counted + quarantined, not fatal (the peer's own host owns its
+    # recovery).
+    supervise: bool = True
+    supervisor_max_restarts: int = 3
     # multihost: how long an actor-less listening learner waits for its
     # first remote actor-host connection before it may report idle
     # (raise for cluster queues / slow container pulls; too low and a
@@ -206,6 +220,20 @@ class CommConfig:
     default is on."""
 
     wire_codec: str = "delta-deflate"
+    # Supervised reconnect (SocketTransport): capped jittered
+    # exponential backoff between reconnect attempts after the
+    # experience connection fails. The cap MUST stay below the
+    # server's idle_grace_s (5.0) — a backing-off fleet retries inside
+    # every quiesce grace window, so a learner blip never reads as
+    # "all producers gone" (see SocketIngestServer.quiesced).
+    reconnect_base_s: float = 0.05
+    reconnect_cap_s: float = 2.0
+    # Offer the server-initiated param publication capability in the
+    # hello (MSG_PARAMS_PUSH): params arrive at publish boundaries
+    # instead of on the poll cadence. Off by default — the poll path
+    # is the universally-interoperable one; against a pre-push learner
+    # the offer is silently ignored either way.
+    params_push: bool = False
 
 
 @dataclass(frozen=True)

@@ -201,6 +201,8 @@ class FleetAggregator:
         transport.on_telemetry = self.on_frame
         if hasattr(transport, "on_disconnect"):
             transport.on_disconnect = self.on_disconnect
+        if hasattr(transport, "on_decode_error"):
+            transport.on_decode_error = self.on_decode_error
         return True
 
     @property
@@ -293,3 +295,13 @@ class FleetAggregator:
         obs.count("peer_disconnects")
         obs.gauge("fleet_peers", n_connected)
         self._metrics.log(self._step(), peer_disconnect=peer)
+
+    def on_decode_error(self, peer: str, reason: str) -> None:
+        """A truncated/garbled frame arrived (and dropped its
+        connection): counter + attributed JSONL record, so a byzantine
+        or proxy-mangled peer shows up as ITSELF in the run artifact —
+        peer is "unidentified" when the connection never sent
+        telemetry."""
+        self._obs.count("wire_decode_errors")
+        self._metrics.log(self._step(), wire_decode_error=peer,
+                          wire_decode_reason=str(reason)[:200])

@@ -69,6 +69,12 @@ INSTRUMENTS = {
     "telemetry_frames": {"kind": "ctr"},
     "peer_disconnects": {"kind": "ctr"},
     "fleet_peers": {"kind": "gauge"},
+    # elastic fleet runtime (PR 7): supervised recovery + chaos lane
+    "supervisor_restarts": {"kind": "ctr"},
+    "actor_quarantines": {"kind": "ctr"},
+    "peer_stall_events": {"kind": "ctr"},
+    "param_pull_errors": {"kind": "ctr"},
+    "wire_decode_errors": {"kind": "ctr"},
 }
 
 # healthy ranges, derived view kept under its historical name (the

@@ -49,7 +49,8 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
                    param_poll_s: float = 2.0,
                    stop_event: threading.Event | None = None,
                    wait_for_params_s: float = 60.0,
-                   peer_id: str | None = None) -> dict:
+                   peer_id: str | None = None,
+                   supervise: bool = False) -> dict:
     """Run actors against a remote learner until their frame budget ends.
 
     actor_offset positions this host's actors inside the global eps_i
@@ -59,12 +60,24 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     with obs enabled, experience batches are stamped with it plus a
     monotonic batch_id, and a TelemetryEmitter ships obs snapshot
     frames to the learner every cfg.obs.telemetry_every_s.
+
+    supervise=True makes this host survive learner restarts instead of
+    exiting: the bootstrap wait for first params never times out (the
+    transport's supervised reconnect loop keeps re-entering connect/
+    negotiate under backoff until a learner — the same one or a new
+    incarnation at the same address — answers), and mid-run learner
+    loss is already survived by the transport (sends drop-and-back-off,
+    params re-converge to the live epoch on reconnect).
     """
     n = num_actors or cfg.actors.num_actors
     stop_event = stop_event or threading.Event()
     peer = peer_id or default_peer_id(actor_offset)
-    transport = SocketTransport(host, port,
-                                wire_codec=cfg.comm.wire_codec)
+    comm = cfg.comm
+    transport = SocketTransport(
+        host, port, wire_codec=comm.wire_codec,
+        reconnect_base_s=getattr(comm, "reconnect_base_s", 0.05),
+        reconnect_cap_s=getattr(comm, "reconnect_cap_s", 2.0),
+        params_push=getattr(comm, "params_push", False))
     # local obs: metrics stay in-memory (the learner's JSONL is the
     # run's single artifact; this host's view crosses the wire as
     # telemetry frames), and a trace path gets a per-peer suffix so
@@ -80,11 +93,13 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         emitter = TelemetryEmitter(transport, obs, peer,
                                    interval_s=cfg.obs.telemetry_every_s)
 
-    # wait for the learner to publish a first param set
+    # wait for the learner to publish a first param set; under
+    # --supervise the wait is unbounded (a host that outlives its
+    # learner must keep re-entering connect until one comes back)
     deadline = time.monotonic() + wait_for_params_s
     params, version = transport.get_params()
-    while params is None and time.monotonic() < deadline \
-            and not stop_event.is_set():
+    while params is None and not stop_event.is_set() \
+            and (supervise or time.monotonic() < deadline):
         time.sleep(0.2)
         params, version = transport.get_params()
     if params is None:
@@ -116,10 +131,42 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
               "lazily", file=sys.stderr, flush=True)
 
     def param_puller() -> None:
-        while not stop_event.wait(param_poll_s):
-            p, v = transport.get_params()
-            if p is not None and v > server.params_version:
-                server.update_params(p, v)
+        # resilience contract: NOTHING in here may kill the thread — a
+        # transient pull failure keeps last-good params on the server,
+        # bumps the param_pull_errors counter, and widens the poll wait
+        # (bounded backoff) until pulls succeed again. An epoch change
+        # (learner restart) FORCES the update even when the new
+        # incarnation's version counter restarted below ours — version
+        # monotonicity only holds within one epoch.
+        seen_epoch = transport.param_epoch
+        seen_pull_errors = transport.param_pull_errors
+        fail_streak = 0
+        while not stop_event.wait(
+                min(param_poll_s * (2 ** min(fail_streak, 4)), 30.0)):
+            try:
+                # server-pushed params (if negotiated) take priority —
+                # they are publish-fresh; the conditional poll is the
+                # fallback and the keep-alive
+                p, v = transport.poll_pushed_params()
+                if p is None:
+                    p, v = transport.get_params()
+                errs = transport.param_pull_errors
+                if errs > seen_pull_errors:
+                    obs.count("param_pull_errors", errs - seen_pull_errors)
+                    seen_pull_errors = errs
+                    fail_streak += 1
+                    continue
+                fail_streak = 0
+                if p is None:  # "unchanged" reply or nothing pushed
+                    continue
+                ep = transport.param_epoch
+                if v > server.params_version \
+                        or (ep != -1 and ep != seen_epoch):
+                    server.update_params(p, v)
+                seen_epoch = ep
+            except Exception:  # noqa: BLE001 - puller must outlive anything
+                obs.count("param_pull_errors")
+                fail_streak += 1
 
     puller = threading.Thread(target=param_puller, name="param-pull",
                               daemon=True)
@@ -161,6 +208,12 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     transport.close()
     return {"frames": sum(frames), "actors": n,
             "dropped": transport.dropped, "errors": errors,
+            "drop_reasons": transport.drop_reasons,
+            "reconnects": transport.reconnects,
+            "epoch": transport.epoch,
+            "epoch_changes": transport.epoch_changes,
+            "param_pull_errors": transport.param_pull_errors,
+            "param_pushes_in": transport.param_pushes_in,
             "bytes_out": transport.bytes_out,
             "wire_codec": transport.negotiated_codec,
             "wire_compression_ratio": round(
@@ -210,6 +263,13 @@ def main(argv: list[str] | None = None) -> int:
                          "plane (default: hostname-pid-a<offset>); "
                          "shows up as peer/<id>/ in the learner's "
                          "report and in stall attributions")
+    ap.add_argument("--supervise", action="store_true",
+                    help="survive learner restarts: wait indefinitely "
+                         "for first params and keep re-entering the "
+                         "connect/negotiate path (backoff-capped) when "
+                         "the learner goes away mid-run, instead of "
+                         "exiting — the elastic-fleet mode for hosts "
+                         "managed by a process supervisor")
     ap.add_argument("--set", action="append", default=[],
                     metavar="dotted.key=value")
     args = ap.parse_args(argv)
@@ -219,7 +279,8 @@ def main(argv: list[str] | None = None) -> int:
                          actor_offset=args.actor_offset,
                          frames_per_actor=args.frames_per_actor,
                          param_poll_s=args.param_poll_s,
-                         peer_id=args.peer_id)
+                         peer_id=args.peer_id,
+                         supervise=args.supervise)
     print(out)
     return 1 if out["errors"] else 0
 

@@ -17,9 +17,11 @@ Threads:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -207,6 +209,19 @@ class ApexDriver:
         self.actor_errors: list[tuple[int, Exception]] = []  # guarded-by: _lock
         self.actor_restarts: list[tuple[int, str]] = []  # guarded-by: _lock
         self.loop_errors: list[tuple[str, Exception]] = []  # guarded-by: _lock
+        # fleet supervisor state (run()'s poll loop consumes heartbeat
+        # staleness instead of raising for every silent component):
+        # each actor SLOT has its own stop event + thread generation so
+        # a wedged worker can be superseded in place — the old thread,
+        # if it ever un-wedges, sees its generation's event set and
+        # exits instead of double-producing
+        self._slot_stops: dict[int, threading.Event] = {}  # guarded-by: _lock
+        self._slot_threads: dict[int, threading.Thread] = {}  # guarded-by: _lock
+        self._slot_budget: dict[int, int] = {}  # guarded-by: _lock
+        self._slot_actor_obj: dict[int, Any] = {}  # guarded-by: _lock
+        self._slot_restarts: dict[int, int] = {}  # guarded-by: _lock
+        self._quarantined: set[int] = set()  # guarded-by: _lock
+        self._peer_quarantined: set[str] = set()  # guarded-by: _lock
         self._ingested_batches = 0  # guarded-by: _lock
         # host-side mirror of replay fill so the learner hot loop never
         # blocks on a device->host read of state.replay.size (round-1
@@ -348,7 +363,31 @@ class ApexDriver:
         with self._lock:
             self.episode_returns.append(float(info["episode_return"]))
 
-    def _actor_thread(self, i: int, max_frames: int) -> None:
+    def _spawn_actor_slot(self, i: int, max_frames: int,
+                          attempt0: int = 0) -> threading.Thread:
+        """Start (or restart) actor slot i with its own generation stop
+        event. The fleet supervisor supersedes a wedged slot by setting
+        the OLD generation's event and spawning a new one; the global
+        teardown sets every slot event (run()'s finally)."""
+        ev = threading.Event()
+        t = threading.Thread(target=self._actor_thread,
+                             args=(i, max_frames, ev, attempt0),
+                             name=f"actor-{i}", daemon=True)
+        with self._lock:
+            self._slot_stops[i] = ev
+            self._slot_threads[i] = t
+            self._slot_budget[i] = max_frames
+        t.start()
+        return t
+
+    def _actor_threads(self) -> list[threading.Thread]:
+        """Current-generation actor threads (superseded ones excluded)."""
+        with self._lock:
+            return list(self._slot_threads.values())
+
+    def _actor_thread(self, i: int, max_frames: int,
+                      slot_stop: threading.Event | None = None,
+                      attempt0: int = 0) -> None:
         """Supervised actor slot: on a crash the actor is rebuilt (fresh
         env, n-step state, transport handle stay) and resumes the
         REMAINING frame budget, up to actors.max_restarts times —
@@ -356,25 +395,33 @@ class ApexDriver:
         producers; losing one's in-flight transitions is harmless).
         Exhausting the budget records the error, which fails the run
         report (actor_errors)."""
+        stop = slot_stop if slot_stop is not None else self.stop_event
         vector = self.cfg.actors.envs_per_actor > 1
         actor_cls = actor_class(self.family, vector=vector)
         query = self.server.query_batch if vector else self.server.query
         remaining = max_frames
         restarts_left = self.cfg.actors.max_restarts
-        attempt = 0
         # registered here (not in the actor) so a constructor/run that
         # wedges before its first beat is still attributable
         self.obs.register(f"actor-{i}")
         try:
             self._actor_attempts(i, actor_cls, query, remaining,
-                                 restarts_left, attempt)
+                                 restarts_left, attempt0, stop)
         finally:
-            # a finished actor is not a stalled one
-            self.obs.clear(f"actor-{i}")
+            # a finished actor is not a stalled one — but only the slot's
+            # CURRENT generation may clear the heartbeat (a superseded
+            # thread un-wedging late must not blind the watchdog to its
+            # live replacement)
+            with self._lock:
+                current = (slot_stop is None or self._slot_threads.get(i)
+                           is threading.current_thread())
+            if current:
+                self.obs.clear(f"actor-{i}")
 
     def _actor_attempts(self, i, actor_cls, query, remaining,
-                        restarts_left, attempt) -> None:
-        while remaining > 0 and not self.stop_event.is_set():
+                        restarts_left, attempt,
+                        stop: threading.Event) -> None:
+        while remaining > 0 and not stop.is_set():
             actor = None
             try:
                 # salt the seed per attempt: an unsalted rebuild replays
@@ -387,7 +434,11 @@ class ApexDriver:
                                   self.transport, seed=seed,
                                   episode_callback=self._on_episode,
                                   obs=self.obs)
-                actor.run(remaining, self.stop_event)
+                # the supervisor reads this actor's frame count when it
+                # supersedes a wedged slot (remaining-budget estimate)
+                with self._lock:
+                    self._slot_actor_obj[i] = actor
+                actor.run(remaining, stop)
                 return  # frames counted at ingest
             except Exception as e:
                 # frames the crashed actor already ingested stay counted;
@@ -397,7 +448,7 @@ class ApexDriver:
                 # error, not a "recovered" restart — e.g. the final
                 # force-ship failing after all frames were stepped
                 if (restarts_left <= 0 or remaining <= 0
-                        or self.stop_event.is_set()):
+                        or stop.is_set()):
                     with self._lock:
                         self.actor_errors.append((i, e))
                     return
@@ -406,6 +457,113 @@ class ApexDriver:
                 with self._lock:
                     self.actor_restarts.append((i, repr(e)))
                 self.metrics.log(self._grad_steps_total, actor_restart=i)
+
+    # -- fleet supervisor --------------------------------------------------
+
+    _FATAL_COMPONENTS = ("learner", "ingest", "inference-server", "eval")
+
+    def _supervise_tick(self) -> None:
+        """One supervisory pass over heartbeat staleness, replacing the
+        bare check_stalled() raise in run()'s poll loop.
+
+        Partition of stale components (actors.supervise):
+        - local actor slots (actor-N): restart in place with the
+          remaining frame budget, up to actors.supervisor_max_restarts
+          per slot; past the budget the slot is QUARANTINED (heartbeat
+          cleared, actor_quarantines counter, attributed JSONL event)
+          and the run continues degraded — a restart storm must never
+          become a crash loop.
+        - remote peers (telemetry heartbeats): quarantined + counted
+          (peer_stall_events) — the peer's own host supervises its
+          workers; this learner just stops waiting on it.
+        - fatal locals (learner / ingest / inference-server / eval):
+          fall through to check_stalled(), which raises the attributed
+          StallError — a driver cannot restart its own learner."""
+        obs = self.obs
+        if obs.watchdog is None:
+            return
+        if not getattr(self.cfg.actors, "supervise", False):
+            obs.check_stalled()
+            return
+        for name, staleness, _note in obs.heartbeats.stale(
+                obs.watchdog.timeout_s):
+            slot = name[len("actor-"):] if name.startswith("actor-") else ""
+            if slot.isdigit():
+                self._supervise_actor(int(slot), staleness)
+            elif name not in self._FATAL_COMPONENTS:
+                self._quarantine_peer(name, staleness)
+        # anything still stale is a fatal local component
+        obs.check_stalled()
+
+    def _supervise_actor(self, i: int, staleness: float) -> None:
+        """Restart or quarantine one wedged LOCAL actor slot."""
+        with self._lock:
+            if i in self._quarantined:
+                return
+            used = self._slot_restarts.get(i, 0)
+            exhausted = used >= self.cfg.actors.supervisor_max_restarts
+            if exhausted:
+                self._quarantined.add(i)
+            else:
+                self._slot_restarts[i] = used + 1
+            old_ev = self._slot_stops.get(i)
+            actor = self._slot_actor_obj.pop(i, None)
+            budget = self._slot_budget.get(i, 0)
+        if old_ev is not None:
+            old_ev.set()  # superseded generation exits if it un-wedges
+        if exhausted:
+            self.obs.clear(f"actor-{i}")
+            self.obs.count("actor_quarantines")
+            self.metrics.log(self._grad_steps_total, actor_quarantined=i,
+                             stall_staleness_s=round(staleness, 1))
+            logging.getLogger(__name__).warning(
+                "[fleet] actor slot %d exhausted its supervised-restart "
+                "budget (%d) — quarantined; the run continues without it",
+                i, self.cfg.actors.supervisor_max_restarts)
+            return
+        done = 0
+        if actor is not None:
+            try:
+                done = int(actor.frames)
+            except (TypeError, ValueError, AttributeError):
+                done = 0
+        remaining = max(budget - done, 0)
+        self.obs.count("supervisor_restarts")
+        with self._lock:
+            self.actor_restarts.append(
+                (i, f"supervised: stalled {staleness:.1f}s"))
+        self.metrics.log(self._grad_steps_total, supervisor_restart=i,
+                         stall_staleness_s=round(staleness, 1))
+        # re-arm the heartbeat NOW so the check_stalled() fallthrough in
+        # this very tick doesn't still see the slot as stale
+        self.obs.beat(f"actor-{i}", "supervised restart")
+        if remaining > 0:
+            # fresh seed salt stream for the superseded generation's
+            # successor (offset past crash-restart salts)
+            self._spawn_actor_slot(i, remaining,
+                                   attempt0=100 + self._slot_restarts[i])
+        else:
+            self.obs.clear(f"actor-{i}")
+
+    def _quarantine_peer(self, name: str, staleness: float) -> None:
+        """A REMOTE component's telemetry heartbeat went stale: count
+        it, attribute it in the JSONL, and clear the heartbeat so it
+        cannot wedge this driver's watchdog — the peer's own host owns
+        its recovery (actor_host --supervise); if it reconnects, its
+        next telemetry frame re-registers the heartbeat."""
+        with self._lock:
+            first = name not in self._peer_quarantined
+            self._peer_quarantined.add(name)
+        self.obs.clear(name)
+        self.obs.count("peer_stall_events")
+        self.metrics.log(self._grad_steps_total, peer_stall=name,
+                         stall_staleness_s=round(staleness, 1))
+        if first:
+            logging.getLogger(__name__).warning(
+                "[fleet] remote component %r silent for %.1fs — "
+                "quarantined from the stall watchdog (its host owns "
+                "recovery); ingest continues from the remaining fleet",
+                name, staleness)
 
     def _min_fill(self) -> int:
         return min(self.cfg.replay.min_fill, self.capacity // 2)
@@ -909,11 +1067,6 @@ class ApexDriver:
             # they do). Anything else — shape mismatches, compile OOM —
             # is a real bug that must surface, not a degraded start.
             self.metrics.log(0, warmup_skipped=repr(e))
-        threads = [
-            threading.Thread(target=self._actor_thread, args=(i, per_actor),
-                             name=f"actor-{i}", daemon=True)
-            for i in range(self.cfg.actors.num_actors)
-        ]
         ingest = threading.Thread(target=self._ingest_loop, name="ingest",
                                   daemon=True)
         learner = threading.Thread(target=self._learner_loop,
@@ -927,17 +1080,20 @@ class ApexDriver:
         learner.start()
         if evaluator is not None:
             evaluator.start()
-        for t in threads:
-            t.start()
+        for i in range(self.cfg.actors.num_actors):
+            self._spawn_actor_slot(i, per_actor)
         saw_remote = False
         try:
             prev_stuck_at = -1  # _ingested_batches at last stuck sighting
             while True:
-                # attributed stall error instead of a silent hang: the
-                # poll loop is the one thread guaranteed alive while a
-                # worker wedges, so the watchdog raises HERE and the
-                # finally-teardown below still runs
-                self.obs.check_stalled()
+                # attributed stall handling instead of a silent hang:
+                # the poll loop is the one thread guaranteed alive while
+                # a worker wedges. The supervisor tick restarts /
+                # quarantines recoverable components (local actor
+                # slots, remote peers) and raises the watchdog's
+                # StallError only for fatal locals — the finally-
+                # teardown below still runs on that path
+                self._supervise_tick()
                 if (wall_clock_limit_s is not None
                         and time.monotonic() - t0 > wall_clock_limit_s):
                     break
@@ -972,7 +1128,7 @@ class ApexDriver:
                     if booting or not remote_quiet:
                         time.sleep(0.2)
                         continue
-                if not any(t.is_alive() for t in threads):
+                if not any(t.is_alive() for t in self._actor_threads()):
                     # actors finished: drain pending experience, then let
                     # the learner reach a finite grad-step target — UNLESS
                     # it can never make progress (replay stuck below
@@ -1001,7 +1157,13 @@ class ApexDriver:
                 time.sleep(0.2)
         finally:
             self.stop_event.set()
-            for t in threads:
+            # per-slot generations stop on their own events; the global
+            # event covers the ingest/learner/eval loops
+            with self._lock:
+                slot_events = list(self._slot_stops.values())
+            for ev in slot_events:
+                ev.set()
+            for t in self._actor_threads():
                 t.join(timeout=5)
             learner.join(timeout=10)
             ingest.join(timeout=5)
@@ -1057,6 +1219,8 @@ class ApexDriver:
             "ingest_dropped": self.transport.dropped + self._stage_dropped,
             "actor_errors": list(self.actor_errors),
             "actor_restarts": list(self.actor_restarts),
+            "actor_quarantines": sorted(self._quarantined),
+            "supervisor_restarts": dict(self._slot_restarts),
             "loop_errors": list(self.loop_errors),
             "eval": self.last_eval,
         }

@@ -837,6 +837,152 @@ def bench_wire_ab(args) -> dict:
     return out
 
 
+def bench_chaos_ab(args) -> dict:
+    """A/B the elastic fleet runtime under fault injection: the same
+    sender fleet pushes experience through a chaos proxy for a fixed
+    wall-clock window, once over a clean link and once through the
+    full fault schedule — a garble phase, a link cut, and a learner
+    kill + restart (new incarnation, same port, new epoch). The
+    headline number is availability: chaos-arm ingest throughput as a
+    fraction of the clean arm's, with the outage that the reconnect
+    loop must amortize sitting INSIDE the timed window. Also reports
+    the client-measured reconnect latencies (time from first failed
+    send to the re-entered connection) and the fault attribution
+    counters the lane asserts on (every drop classified, every bad
+    frame counted)."""
+    import threading
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport)
+    from tools.chaos import ChaosProxy
+
+    n_wire, f, b = 8, 12, 12
+    msgs = _wire_ab_messages(4, n_wire, f, b)
+    window_s = args.chaos_ab_seconds
+    n_clients = 2
+
+    def _converged(c) -> bool:
+        # a client still inside its backoff window needs a few polls
+        # before a pull lands on the new incarnation
+        for _ in range(30):
+            c.get_params()
+            if c.epoch == 2:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def arm(chaos: bool) -> dict:
+        srv = SocketIngestServer("127.0.0.1", 0, epoch=1)
+        port = srv.port
+        proxy = ChaosProxy("127.0.0.1", port, seed=17)
+        srv.publish_params({"w": np.float32(0)}, 0)
+        live = {"srv": srv}
+        clients = [SocketTransport("127.0.0.1", proxy.port,
+                                   reconnect_base_s=0.01,
+                                   reconnect_cap_s=0.3,
+                                   connect_timeout=2.0)
+                   for _ in range(n_clients)]
+        stop = threading.Event()
+        rows = {"n": 0}
+        decode_errs_prior = {"n": 0}  # from incarnations already stopped
+        rows_lock = threading.Lock()
+
+        def pump(c, k):
+            i = 0
+            while not stop.is_set():
+                c.send_experience(msgs[(k + i) % len(msgs)])
+                i += 1
+                time.sleep(0.002)
+
+        def drain():
+            while not stop.is_set():
+                m = live["srv"].recv_experience(timeout=0.1)
+                if m is not None:
+                    with rows_lock:
+                        rows["n"] += m.rows
+            # post-window flush so both arms count queued residue
+            while True:
+                m = live["srv"].recv_experience(timeout=0.05)
+                if m is None:
+                    return
+                with rows_lock:
+                    rows["n"] += m.rows
+
+        threads = [threading.Thread(target=pump, args=(c, k),
+                                    daemon=True)
+                   for k, c in enumerate(clients)]
+        drainer = threading.Thread(target=drain, daemon=True)
+        t0 = time.monotonic()
+        drainer.start()
+        for t in threads:
+            t.start()
+        if chaos:
+            # fault schedule inside the window: degrade, cut, kill
+            time.sleep(window_s * 0.25)
+            proxy.set_fault(garble_rate=0.05)
+            time.sleep(window_s * 0.25)
+            proxy.clean()
+            proxy.cut()
+            decode_errs_prior["n"] = srv.wire_decode_errors
+            srv.stop()
+            time.sleep(window_s * 0.15)  # the outage
+            srv2 = SocketIngestServer("127.0.0.1", port, epoch=2)
+            srv2.publish_params({"w": np.float32(1)}, 0)
+            live["srv"] = srv2
+            time.sleep(window_s * 0.35)
+        else:
+            time.sleep(window_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        drainer.join(timeout=5)
+        dt = time.monotonic() - t0
+        lat = sorted(x for c in clients
+                     for x in c.reconnect_latencies)
+        out = {
+            "rows_per_s": rows["n"] * b / dt,
+            "reconnects": sum(c.reconnects for c in clients),
+            "reconnect_latency_ms": {
+                "median": round(1000 * lat[len(lat) // 2], 1)
+                if lat else None,
+                "max": round(1000 * lat[-1], 1) if lat else None,
+            },
+            "drop_reasons": {
+                k: sum(c.drop_reasons[k] for c in clients)
+                for k in clients[0].drop_reasons},
+            "epochs_converged": all(map(_converged, clients))
+            if chaos else None,
+            "wire_decode_errors": decode_errs_prior["n"]
+            + live["srv"].wire_decode_errors,
+        }
+        for c in clients:
+            c.close()
+        proxy.stop()
+        live["srv"].stop()
+        return out
+
+    out: dict = {"window_s": window_s, "clients": n_clients,
+                 "transitions_per_unit": b}
+    clean_runs, chaos_runs = [], []
+    for _ in range(args.repeats):
+        clean = arm(chaos=False)
+        chaos = arm(chaos=True)
+        clean_runs.append(clean["rows_per_s"])
+        chaos_runs.append(chaos["rows_per_s"])
+        out["clean"], out["chaos"] = clean, chaos
+    out["clean"]["rows_per_s"] = spread(clean_runs)
+    out["chaos"]["rows_per_s"] = spread(chaos_runs)
+    out["availability"] = round(
+        spread(chaos_runs)["median"] / spread(clean_runs)["median"], 3)
+    log(f"chaos A/B: clean {spread(clean_runs)} rows/s vs chaos "
+        f"{spread(chaos_runs)} rows/s -> availability "
+        f"{out['availability']} (reconnect median "
+        f"{out['chaos']['reconnect_latency_ms']['median']} ms, "
+        f"decode errors {out['chaos']['wire_decode_errors']}, "
+        f"epochs converged {out['chaos']['epochs_converged']})")
+    return out
+
+
 def wire_codec_summary() -> dict:
     """Cheap in-memory codec ratio on the Atari-like synthetic frames —
     recorded in every default bench run so BENCH artifacts carry the
@@ -1099,6 +1245,17 @@ def main() -> None:
                    help="simulated link MB/s for the capped wire-ab "
                    "arm (default = the round-4 measured live ingest "
                    "rate)")
+    p.add_argument("--chaos-ab", action="store_true",
+                   help="run the chaos-lane A/B instead of the main "
+                   "bench (same sender fleet through a ChaosProxy, "
+                   "clean link vs garble + cut + learner restart "
+                   "inside the timed window, median-of-`--repeats` "
+                   "per arm): availability ratio, reconnect latency, "
+                   "fault attribution counters")
+    p.add_argument("--chaos-ab-seconds", type=float, default=4.0,
+                   help="timed window per chaos-ab arm; the fault "
+                   "schedule (garble phase, cut, restart outage) is "
+                   "proportional to it")
     p.add_argument("--ab-batch-size", type=int, default=64,
                    help="batch size for the prefetch A/B arms (small "
                    "enough to iterate on a CPU host; raise on a real "
@@ -1157,6 +1314,16 @@ def main() -> None:
             "unit": "bytes",
             "vs_baseline": ab["raw_first"]["delta-deflate"]["ratio"],
             "secondary": {"wire_ab": ab},
+        }), flush=True)
+        return
+    if args.chaos_ab:
+        ab = bench_chaos_ab(args)
+        print(json.dumps({
+            "metric": "chaos_availability",
+            "value": ab["availability"],
+            "unit": "ratio",
+            "vs_baseline": ab["availability"],
+            "secondary": {"chaos_ab": ab},
         }), flush=True)
         return
     h2d_rates = bench_h2d(repeats=args.repeats)

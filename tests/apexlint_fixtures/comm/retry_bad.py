@@ -1,0 +1,11 @@
+"""retry-annotation fixture: a swallowed OSError with no counter,
+no accounting bump, and no waiver — the silent-loss shape the rule
+exists to catch."""
+
+
+class Transport:
+    def send(self, sock, data):
+        try:
+            sock.sendall(data)
+        except OSError:
+            pass

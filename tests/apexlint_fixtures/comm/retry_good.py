@@ -1,0 +1,50 @@
+"""retry-annotation fixture: every swallowed socket error is
+observable — via an obs counter, an accounting bump, a _note_*
+delegation, a waiver, or a re-raise."""
+
+
+class Transport:
+    def __init__(self):
+        self._dropped = 0
+        self._obs = None
+
+    def send_counted(self, sock, data):
+        try:
+            sock.sendall(data)
+        except OSError:
+            self._obs.count("send_drops")
+
+    def send_bumped(self, sock, data):
+        try:
+            sock.sendall(data)
+        except ConnectionResetError:
+            self._dropped += 1
+
+    def send_delegated(self, sock, data):
+        try:
+            sock.sendall(data)
+        except (OSError, TimeoutError) as e:
+            self._note_send_failure(e)
+
+    def close(self, sock):
+        try:
+            sock.close()
+        except OSError:  # apexlint: lossy(close best effort)
+            pass
+
+    def send_reraising(self, sock, data):
+        try:
+            sock.sendall(data)
+        except OSError:
+            if self._obs is None:
+                raise
+            self._obs.count("send_drops")
+
+    def decode(self, blob):
+        try:
+            return blob.decode()
+        except ValueError:  # not a socket error: out of this rule's scope
+            return None
+
+    def _note_send_failure(self, exc):
+        self._dropped += 1

@@ -1,0 +1,30 @@
+"""wire-protocol fixture: the server grew a MSG_PARAMS_PUSH plane but
+the client dispatch chain never references it — the half-wired shape
+the checker exists to catch."""
+
+MSG_HELLO = 1
+MSG_EXPERIENCE = 2
+MSG_PARAMS = 3
+MSG_PARAMS_PUSH = 8
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_HELLO:
+            return MSG_PARAMS
+        if mtype == MSG_EXPERIENCE:
+            return payload
+        return None
+
+    def push_loop(self, subs, blob):
+        for sock in subs:
+            sock.send((MSG_PARAMS_PUSH, blob))
+
+
+class Client:
+    def run(self, sock):
+        sock.send(MSG_HELLO)
+        if sock.recv() != MSG_PARAMS:
+            return False
+        sock.send(MSG_EXPERIENCE)
+        return True

@@ -1,0 +1,34 @@
+"""wire-protocol fixture: MSG_PARAMS_PUSH wired into both chains —
+the server's push loop ships it, the client's reader consumes it."""
+
+MSG_HELLO = 1
+MSG_EXPERIENCE = 2
+MSG_PARAMS = 3
+MSG_PARAMS_PUSH = 8
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_HELLO:
+            return MSG_PARAMS
+        if mtype == MSG_EXPERIENCE:
+            return payload
+        return None
+
+    def push_loop(self, subs, blob):
+        for sock in subs:
+            sock.send((MSG_PARAMS_PUSH, blob))
+
+
+class Client:
+    def run(self, sock):
+        sock.send(MSG_HELLO)
+        if sock.recv() != MSG_PARAMS:
+            return False
+        sock.send(MSG_EXPERIENCE)
+        return True
+
+    def push_reader(self, sock):
+        mtype, payload = sock.recv()
+        if mtype == MSG_PARAMS_PUSH:
+            self.on_push(payload)

@@ -25,7 +25,7 @@ sys.path.insert(0, REPO_ROOT)  # tools/ is repo-local, not installed
 
 from tools.apexlint import run as apexlint_run  # noqa: E402
 from tools.apexlint import guarded_by, jit_purity, obs_names, \
-    wire_protocol  # noqa: E402
+    retry_annotation, wire_protocol  # noqa: E402
 
 
 def _fx(name: str) -> str:
@@ -53,7 +53,8 @@ def test_cli_json_subprocess():
     summary = json.loads(out.stdout)
     assert summary["findings"] == []
     assert set(summary["per_checker"]) == {
-        "guarded-by", "jit-purity", "wire-protocol", "obs-names"}
+        "guarded-by", "jit-purity", "wire-protocol", "obs-names",
+        "retry-annotation"}
 
 
 def test_cli_text_nonzero_exit_on_findings(tmp_path):
@@ -123,6 +124,44 @@ def test_wire_protocol_telemetry_fixtures():
     f = bad.findings[0]
     assert f.checker == "wire-protocol"
     assert "MSG_TELEMETRY" in f.message and "Server" in f.message
+
+
+def test_wire_protocol_push_fixtures():
+    good = wire_protocol.check_paths([_fx("wire_push_good.py")])
+    assert good.findings == []
+    assert good.waivers == 0  # push wired into both chains
+
+    bad = wire_protocol.check_paths([_fx("wire_push_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "wire-protocol"
+    assert "MSG_PARAMS_PUSH" in f.message and "Client" in f.message
+
+
+def test_retry_annotation_fixtures():
+    good = retry_annotation.check_paths(
+        [_fx(os.path.join("comm", "retry_good.py"))])
+    assert good.findings == []
+    assert good.waivers == 1  # the justified close-path waiver
+
+    bad = retry_annotation.check_paths(
+        [_fx(os.path.join("comm", "retry_bad.py"))])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "retry-annotation"
+    assert "OSError" in f.message and "lossy" in f.message
+
+
+def test_retry_annotation_scope_is_comm_and_runtime(tmp_path):
+    # the same silent swallow OUTSIDE comm/ or runtime/ is not flagged:
+    # the rule is about the transport/runtime loss contract, not a
+    # repo-wide style ban
+    bad_src = open(
+        _fx(os.path.join("comm", "retry_bad.py")), encoding="utf-8").read()
+    elsewhere = tmp_path / "elsewhere.py"
+    elsewhere.write_text(bad_src)
+    res = retry_annotation.check_paths([str(elsewhere)])
+    assert res.findings == []
 
 
 def test_obs_names_fixtures():

@@ -1,0 +1,655 @@
+"""Chaos lane (ISSUE 7): fault injection against the elastic fleet
+runtime. Fast variants run tier-1 (seconds, loopback sockets, real
+wire); the full soak is slow-marked.
+
+What the lane proves, per fault family:
+- learner loss mid-run: clients classify the drops, back off with
+  jitter, reconnect to the NEW incarnation (epoch bump observed),
+  and ingest resumes — the learner side never crashes;
+- wire damage (garble/truncate/fuzz): every bad frame is an
+  ATTRIBUTED counter (wire_decode_errors + on_decode_error hook),
+  never an unhandled exception in a reader thread;
+- wedged local actors: the driver's fleet supervisor restarts the
+  slot within its budget, then quarantines — a restart storm
+  degrades, it does not crash-loop;
+- quiesce debounce: a fleet riding out a blip (clients in capped
+  backoff) never reads as quiesced, because the backoff cap is
+  pinned BELOW the server's idle grace.
+"""
+
+import inspect
+import json
+import pickle
+import random
+import socket as socket_mod
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.comm import socket_transport as st
+from ape_x_dqn_tpu.comm.socket_transport import (
+    MSG_EXPERIENCE, MSG_PARAMS_REQ, MSG_TELEMETRY,
+    SocketIngestServer, SocketTransport, _recv_msg, _send_msg)
+from ape_x_dqn_tpu.configs import CommConfig, ObsConfig
+from tools.chaos import (ChaosProxy, CORRUPTION_MODES, ThreadWedge,
+                         corrupt_frame, kill_process)
+from tools.chaos.faults import frame as good_frame
+
+PEER = "chaos-host-1-a0"
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"obs": rng.random((n, 4)).astype(np.float32),
+            "action": rng.integers(0, 2, (n,)).astype(np.int32),
+            "priorities": (rng.random(n) + 0.1).astype(np.float32),
+            "actor": 0, "frames": n}
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _client(port, **kw):
+    kw.setdefault("reconnect_base_s", 0.01)
+    kw.setdefault("reconnect_cap_s", 0.2)
+    return SocketTransport("127.0.0.1", port, **kw)
+
+
+# -- fault primitives -------------------------------------------------------
+
+def test_thread_wedge_blocks_and_releases():
+    wedge = ThreadWedge()
+    beats = []
+
+    def worker():
+        for i in range(1000):
+            wedge.checkpoint(timeout=5.0)
+            beats.append(i)
+            if stop.is_set():
+                return
+            time.sleep(0.005)
+
+    stop = threading.Event()
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert _wait(lambda: len(beats) >= 3)
+    wedge.engage()
+    time.sleep(0.05)
+    n = len(beats)
+    time.sleep(0.2)
+    assert len(beats) <= n + 1  # silent while engaged
+    assert wedge.engaged
+    wedge.release()
+    assert _wait(lambda: len(beats) > n + 1)  # resumed, not dead
+    stop.set()
+    t.join(timeout=2)
+
+
+def test_kill_process_tolerates_already_dead():
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait(timeout=10)
+    kill_process(proc)  # reaped: must not raise
+    kill_process(None)
+
+
+def test_corrupt_frame_modes_all_differ_from_good():
+    rng = random.Random(7)
+    good = good_frame(MSG_EXPERIENCE, b"payload-bytes" * 4)
+    for mode in CORRUPTION_MODES:
+        bad = corrupt_frame(MSG_EXPERIENCE, b"payload-bytes" * 4,
+                            mode, rng)
+        assert bad != good, mode
+    with pytest.raises(ValueError):
+        corrupt_frame(MSG_EXPERIENCE, b"x", "no-such-mode")
+
+
+# -- learner loss: reconnect, epoch, drop classification --------------------
+
+def test_server_restart_reconnect_and_epoch_bump():
+    """The headline fault: the learner dies mid-run and a NEW
+    incarnation binds the same port. Clients classify the outage
+    drops, reconnect under backoff, observe the epoch change, and
+    ingest resumes — no client-side exception escapes."""
+    srv1 = SocketIngestServer("127.0.0.1", 0, epoch=1)
+    port = srv1.port
+    client = _client(port)
+    try:
+        client.send_experience(_batch())
+        assert srv1.recv_experience(timeout=5.0) is not None
+        assert client.epoch == 1 and client.epoch_changes == 0
+        srv1.stop()
+
+        for i in range(6):  # outage: drops classified, never raised
+            client.send_experience(_batch(seed=i))
+            time.sleep(0.02)
+        assert client.dropped >= 1
+        assert sum(client.drop_reasons.values()) == client.dropped
+
+        srv2 = SocketIngestServer("127.0.0.1", port, epoch=2)
+        try:
+            got = None
+
+            def resumed():
+                nonlocal got
+                client.send_experience(_batch())
+                got = srv2.recv_experience(timeout=0.2)
+                return got is not None
+
+            assert _wait(resumed), "ingest never resumed after restart"
+            assert client.reconnects >= 1
+            assert client.reconnect_latencies  # outage length sampled
+            assert client.epoch == 2 and client.epoch_changes == 1
+        finally:
+            srv2.stop()
+    finally:
+        client.close()
+
+
+def test_sends_during_backoff_drop_as_backpressure():
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=1)
+    port = srv.port
+    # long cap: after the first failure the backoff window is open for
+    # the whole test, so the second send must take the cheap gate
+    client = _client(port, reconnect_base_s=5.0, reconnect_cap_s=10.0)
+    try:
+        client.send_experience(_batch())
+        assert srv.recv_experience(timeout=5.0) is not None
+        srv.stop()
+
+        def hard_drop():
+            # the first post-mortem send can land in the socket buffer
+            # before the RST arrives — keep sending until one faults
+            client.send_experience(_batch())
+            r = client.drop_reasons
+            return (r["reset"] + r["refused"] + r["timeout"]
+                    + r["other"] >= 1)
+
+        assert _wait(hard_drop, timeout=3.0)
+        client.send_experience(_batch())  # backoff window: backpressure
+        assert client.drop_reasons["backpressure"] >= 1
+        assert sum(client.drop_reasons.values()) == client.dropped
+    finally:
+        client.close()
+
+
+def test_proxy_cut_forces_reconnect():
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=3)
+    proxy = ChaosProxy("127.0.0.1", srv.port)
+    client = _client(proxy.port)
+    try:
+        client.send_experience(_batch())
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert proxy.cut() >= 2
+        assert _wait(lambda: (client.send_experience(_batch()),
+                              client.reconnects >= 1)[1])
+        # same incarnation behind the blip: NO epoch change
+        assert client.epoch == 3 and client.epoch_changes == 0
+    finally:
+        client.close()
+        proxy.stop()
+        srv.stop()
+
+
+# -- versioned param plane --------------------------------------------------
+
+def test_conditional_param_pull_cycle():
+    """Full pull -> header-only 'unchanged' -> full on new version ->
+    forced full on epoch bump (version counters restart across
+    incarnations, so the epoch keys the update)."""
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=5)
+    client = _client(srv.port)
+    try:
+        srv.publish_params({"w": 0}, 0)
+        p, v = client.get_params()
+        assert p == {"w": 0} and v == 0
+        p, v = client.get_params()  # nothing new: header-only reply
+        assert p is None and v == 0
+        assert client.param_unchanged >= 1
+
+        srv.publish_params({"w": 1}, 1)
+        p, v = client.get_params()
+        assert p == {"w": 1} and v == 1
+
+        srv.bump_epoch()  # "new incarnation" without the restart
+        p, v = client.get_params()  # epoch mismatch: full reply again
+        assert p == {"w": 1} and v == 1
+        assert client.epoch_changes == 1
+        assert client.param_epoch == 6
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_params_push_delivery():
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=9)
+    client = _client(srv.port, params_push=True)
+    try:
+        client.send_experience(_batch())  # connect + negotiate
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert client.params_push_negotiated
+        assert srv.push_subscribers == 1
+        srv.publish_params({"w": 2}, 3)
+        assert _wait(lambda: client.param_pushes_in >= 1)
+        p, v = client.poll_pushed_params()
+        assert p == {"w": 2} and v == 3
+        p, v = client.poll_pushed_params()  # consumed
+        assert p is None and v == -1
+        assert srv.param_pushes >= 1
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_pull_failure_bumps_param_pull_errors():
+    srv = SocketIngestServer("127.0.0.1", 0)
+    port = srv.port
+    srv.publish_params({"w": 0}, 0)
+    client = _client(port)
+    try:
+        p, _ = client.get_params()
+        assert p == {"w": 0}
+        srv.stop()
+        p, v = client.get_params()  # learner gone: error, not raise
+        assert p is None and v == -1
+        assert client.param_pull_errors >= 1
+    finally:
+        client.close()
+
+
+# -- wire damage: attributed, never fatal -----------------------------------
+
+def test_garbled_frame_counted_and_attributed():
+    srv = SocketIngestServer("127.0.0.1", 0)
+    seen = []
+    srv.on_decode_error = lambda peer, reason: seen.append((peer, reason))
+    sock = socket_mod.create_connection(("127.0.0.1", srv.port))
+    try:
+        # identify the connection first (telemetry names the peer),
+        # then damage it: the decode error must carry the peer name
+        _send_msg(sock, MSG_TELEMETRY,
+                  json.dumps({"peer": PEER, "seq": 0}).encode())
+        assert _wait(lambda: srv.telemetry_frames >= 1)
+        sock.sendall(corrupt_frame(MSG_EXPERIENCE, b"x" * 64, "bad-crc"))
+        assert _wait(lambda: srv.wire_decode_errors >= 1)
+        assert seen and seen[0][0] == PEER
+        assert "checksum" in seen[0][1]
+    finally:
+        sock.close()
+        srv.stop()
+
+
+def test_unidentified_peer_decode_error_attribution():
+    srv = SocketIngestServer("127.0.0.1", 0)
+    seen = []
+    srv.on_decode_error = lambda peer, reason: seen.append((peer, reason))
+    sock = socket_mod.create_connection(("127.0.0.1", srv.port))
+    try:
+        sock.sendall(corrupt_frame(MSG_EXPERIENCE, b"x" * 64,
+                                   "bad-magic"))
+        assert _wait(lambda: srv.wire_decode_errors >= 1)
+        assert seen and seen[0][0] == "unidentified"
+    finally:
+        sock.close()
+        srv.stop()
+
+
+def test_fuzzed_frames_never_crash_server():
+    """~50 corrupted frames across every corruption mode, then a clean
+    client proves the server still serves: damage costs connections
+    and counters, never the process."""
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=1)
+    rng = random.Random(1234)
+    payloads = [b"", b"\x00" * 7, b"garbage" * 19,
+                pickle.dumps({"not": "a batch"})]
+    try:
+        for i in range(50):
+            mode = CORRUPTION_MODES[i % len(CORRUPTION_MODES)]
+            mtype = rng.choice([MSG_EXPERIENCE, MSG_PARAMS_REQ,
+                                MSG_TELEMETRY, 0, 255])
+            data = corrupt_frame(mtype, rng.choice(payloads), mode, rng)
+            sock = socket_mod.create_connection(("127.0.0.1", srv.port))
+            try:
+                sock.sendall(data)
+            finally:
+                sock.close()
+        # raw junk that is not even a frame
+        sock = socket_mod.create_connection(("127.0.0.1", srv.port))
+        sock.sendall(bytes(rng.randrange(256) for _ in range(333)))
+        sock.close()
+
+        client = _client(srv.port)
+        try:
+            client.send_experience(_batch())
+            assert srv.recv_experience(timeout=5.0) is not None
+        finally:
+            client.close()
+        assert srv.wire_decode_errors >= 1
+    finally:
+        srv.stop()
+
+
+# -- quiesce debounce vs the reconnect loop ---------------------------------
+
+def test_quiesced_debounce_and_ever_connected():
+    srv = SocketIngestServer("127.0.0.1", 0, idle_grace_s=0.3)
+    try:
+        # never-connected server is quiesced (boot grace is the
+        # driver's job, keyed on ever_connected)
+        assert not srv.ever_connected
+        assert srv.quiesced()
+
+        client = _client(srv.port)
+        client.send_experience(_batch())
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert srv.ever_connected
+        assert not srv.quiesced()  # live producer
+
+        client.close()  # the blip
+        assert _wait(lambda: srv.active_connections == 0)
+        # inside the grace window a vanished producer is NOT quiesced
+        assert not srv.quiesced()
+        assert _wait(lambda: srv.quiesced(), timeout=2.0)  # grace over
+        assert srv.ever_connected  # latched for good
+    finally:
+        srv.stop()
+
+
+def test_param_probe_does_not_latch_ever_connected():
+    srv = SocketIngestServer("127.0.0.1", 0)
+    client = _client(srv.port)
+    try:
+        srv.publish_params({"w": 0}, 0)
+        client.get_params()  # param-only probe: not a producer
+        assert not srv.ever_connected
+        client.send_experience(_batch())
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert srv.ever_connected
+    finally:
+        client.close()
+        srv.stop()
+
+
+def test_reconnect_cap_pinned_below_idle_grace():
+    """INVARIANT (socket_transport.quiesced docstring): a client's
+    backoff cap must stay below the server's idle grace, so a fleet
+    riding out a blip reconnects inside the grace window its own
+    disconnect opened and the server never reads quiesced mid-blip."""
+    cap = CommConfig().reconnect_cap_s
+    grace = inspect.signature(
+        SocketIngestServer.__init__).parameters["idle_grace_s"].default
+    client_cap = inspect.signature(
+        SocketTransport.__init__).parameters["reconnect_cap_s"].default
+    assert cap < grace, (cap, grace)
+    assert client_cap < grace, (client_cap, grace)
+    assert cap == client_cap  # config default mirrors the transport
+
+
+def test_backing_off_fleet_does_not_quiesce_server():
+    """Clients in capped backoff behind a cut link re-enter within one
+    cap interval: the server side sees the reconnect before the grace
+    expires and never reports quiesced during the blip."""
+    srv = SocketIngestServer("127.0.0.1", 0, idle_grace_s=1.5)
+    proxy = ChaosProxy("127.0.0.1", srv.port)
+    client = _client(proxy.port, reconnect_base_s=0.01,
+                     reconnect_cap_s=0.2)  # cap << grace
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            client.send_experience(_batch())
+            time.sleep(0.02)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        assert srv.recv_experience(timeout=5.0) is not None
+        quiesced_seen = False
+        proxy.cut()
+        deadline = time.monotonic() + 1.0  # blip < grace
+        while time.monotonic() < deadline:
+            quiesced_seen = quiesced_seen or srv.quiesced()
+            time.sleep(0.02)
+        assert not quiesced_seen, \
+            "server read quiesced while the fleet was mid-backoff"
+        assert _wait(lambda: srv.recv_experience(timeout=0.2)
+                     is not None), "ingest never resumed"
+    finally:
+        stop.set()
+        t.join(timeout=2)
+        client.close()
+        proxy.stop()
+        srv.stop()
+
+
+# -- driver fleet supervisor ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def supervised_driver():
+    from ape_x_dqn_tpu.configs import (
+        ActorConfig, InferenceConfig, LearnerConfig, ReplayConfig,
+        get_config)
+    from ape_x_dqn_tpu.runtime.driver import ApexDriver
+    cfg = get_config("cartpole_smoke").replace(
+        actors=ActorConfig(num_actors=2, ingest_batch=16,
+                           supervise=True, supervisor_max_restarts=2),
+        replay=ReplayConfig(kind="prioritized", capacity=1024,
+                            min_fill=64),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        obs=ObsConfig(enabled=True, heartbeat_timeout_s=0.3),
+        eval_every_steps=0, eval_episodes=0)
+    driver = ApexDriver(cfg)
+    yield driver
+    driver.obs.close()
+
+
+def _age_heartbeat(driver, name, keep_alive=True):
+    """Let `name`'s heartbeat go stale past the watchdog timeout while
+    keeping every OTHER registered component fresh (the tick must not
+    trip over e.g. an idle inference-server heartbeat)."""
+    driver.obs.register(name)
+    time.sleep(driver.obs.watchdog.timeout_s + 0.15)
+    if keep_alive:
+        for other in list(driver.obs.heartbeats.ages()):
+            if other != name:
+                driver.obs.beat(other, "test keep-alive")
+
+
+def test_supervisor_restarts_wedged_actor_slot(supervised_driver):
+    driver = supervised_driver
+    spawned = []
+    real_spawn = driver._spawn_actor_slot
+    driver._spawn_actor_slot = \
+        lambda i, f, attempt0=0: spawned.append((i, f, attempt0))
+    try:
+        driver._slot_budget[0] = 640
+        before = driver.obs.registry.counter("supervisor_restarts").value
+        _age_heartbeat(driver, "actor-0")
+        driver._supervise_tick()  # must NOT raise: restart instead
+        assert spawned == [(0, 640, 101)]
+        assert driver._slot_restarts[0] == 1
+        assert driver.obs.registry.counter(
+            "supervisor_restarts").value == before + 1
+        # the re-armed heartbeat keeps the next immediate tick green
+        driver._supervise_tick()
+        assert len(spawned) == 1
+    finally:
+        driver._spawn_actor_slot = real_spawn
+        driver.obs.clear("actor-0")
+
+
+def test_supervisor_quarantines_after_restart_budget(supervised_driver):
+    driver = supervised_driver
+    spawned = []
+    real_spawn = driver._spawn_actor_slot
+    driver._spawn_actor_slot = \
+        lambda i, f, attempt0=0: spawned.append((i, f))
+    try:
+        driver._slot_restarts[1] = \
+            driver.cfg.actors.supervisor_max_restarts  # budget burned
+        before = driver.obs.registry.counter("actor_quarantines").value
+        _age_heartbeat(driver, "actor-1")
+        driver._supervise_tick()  # exhausted: quarantine, not restart
+        assert spawned == []
+        assert 1 in driver._quarantined
+        assert driver.obs.registry.counter(
+            "actor_quarantines").value == before + 1
+        assert "actor-1" not in driver.obs.heartbeats.ages()  # cleared
+        driver._supervise_tick()  # idempotent: stays quarantined
+        assert driver.obs.registry.counter(
+            "actor_quarantines").value == before + 1
+    finally:
+        driver._spawn_actor_slot = real_spawn
+
+
+def test_supervisor_quarantines_stalled_remote_peer(supervised_driver):
+    driver = supervised_driver
+    peer = f"{PEER}/actor-7"
+    before = driver.obs.registry.counter("peer_stall_events").value
+    _age_heartbeat(driver, peer)
+    driver._supervise_tick()  # remote: count + clear, never raise
+    assert driver.obs.registry.counter(
+        "peer_stall_events").value == before + 1
+    assert peer not in driver.obs.heartbeats.ages()
+
+
+def test_supervisor_still_raises_for_fatal_local(supervised_driver):
+    from ape_x_dqn_tpu.obs.health import StallError
+    driver = supervised_driver
+    _age_heartbeat(driver, "learner")
+    try:
+        with pytest.raises(StallError) as ei:
+            driver._supervise_tick()
+        assert ei.value.component == "learner"
+    finally:
+        driver.obs.clear("learner")
+
+
+# -- interop: the chaos harness itself --------------------------------------
+
+def test_chaos_proxy_stats_and_runtime_fault_swap():
+    srv = SocketIngestServer("127.0.0.1", 0)
+    proxy = ChaosProxy("127.0.0.1", srv.port, seed=3)
+    client = _client(proxy.port)
+    try:
+        client.send_experience(_batch())
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert proxy.stats["connections"] >= 1
+        assert proxy.stats["garbled"] == 0
+        proxy.set_fault(garble_rate=1.0)
+        for i in range(10):
+            client.send_experience(_batch(seed=i))
+            time.sleep(0.01)
+        assert _wait(lambda: proxy.stats["garbled"] >= 1)
+        assert _wait(lambda: srv.wire_decode_errors >= 1)
+        proxy.clean()
+        assert _wait(lambda: (client.send_experience(_batch()),
+                              srv.recv_experience(timeout=0.2)
+                              is not None)[1])
+    finally:
+        client.close()
+        proxy.stop()
+        srv.stop()
+
+
+# -- the full soak (slow) ---------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_learner_restart_and_wire_faults():
+    """The acceptance soak: a small fleet of sender threads pushes
+    through a chaos proxy while the learner is killed and restarted
+    (new epoch, same port) and the link degrades through garble and
+    cut phases. Afterwards: ingest resumed on the new incarnation,
+    every client re-converged to the live epoch, faults are
+    attributed, and neither incarnation's server ever crashed."""
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=1, idle_grace_s=5.0)
+    port = srv.port
+    upstream_port = srv.port
+    proxy = ChaosProxy("127.0.0.1", upstream_port, seed=11)
+    srv.publish_params({"w": 0}, 0)
+
+    n_clients = 3
+    clients = [_client(proxy.port, reconnect_base_s=0.01,
+                       reconnect_cap_s=0.3) for _ in range(n_clients)]
+    stop = threading.Event()
+    errors: list[BaseException] = []
+    received = [0]
+    received_lock = threading.Lock()
+
+    def pump(c, k):
+        i = 0
+        while not stop.is_set():
+            try:
+                c.send_experience(_batch(seed=(k * 1000 + i) % 97))
+                c.get_params()
+            except BaseException as e:  # noqa: BLE001 - soak invariant
+                errors.append(e)
+                return
+            i += 1
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=pump, args=(c, k), daemon=True)
+               for k, c in enumerate(clients)]
+    for t in threads:
+        t.start()
+
+    def drain(server, seconds):
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            if server.recv_experience(timeout=0.1) is not None:
+                with received_lock:
+                    received[0] += 1
+
+    try:
+        drain(srv, 1.0)
+        with received_lock:
+            assert received[0] > 0
+
+        proxy.set_fault(garble_rate=0.05)  # degraded-link phase
+        drain(srv, 1.0)
+
+        proxy.clean()
+        srv.stop()  # the learner dies mid-run
+        time.sleep(0.5)  # clients ride the outage in backoff
+        srv2 = SocketIngestServer("127.0.0.1", port, epoch=2,
+                                  idle_grace_s=5.0)
+        srv2.publish_params({"w": 1}, 0)
+        with received_lock:
+            received[0] = 0
+        drain(srv2, 2.0)
+        with received_lock:
+            assert received[0] > 0, "ingest never resumed post-restart"
+
+        proxy.cut()  # one more blip against the new incarnation
+        drain(srv2, 1.0)
+
+        assert errors == [], errors  # no client thread ever raised
+        for c in clients:
+            assert c.reconnects >= 1
+            assert _wait(lambda: (c.get_params(),
+                                  c.epoch == 2)[1]), \
+                f"client never converged to live epoch: {c.epoch}"
+            assert c.epoch_changes >= 1
+            assert sum(c.drop_reasons.values()) == c.dropped
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=2)
+        for c in clients:
+            c.close()
+        proxy.stop()
+        try:
+            srv2.stop()
+        except NameError:
+            pass

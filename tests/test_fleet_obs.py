@@ -2,9 +2,15 @@
 over MSG_TELEMETRY, cross-process trace correlation via stamped
 batch_ids, remote stall attribution through re-beaten heartbeat ages,
 disconnect attribution, and the old-peer negotiation fallbacks — all
-over REAL loopback sockets where the wire is involved."""
+over REAL loopback sockets where the wire is involved.
+
+The epoch-handshake interop matrix (ISSUE 7) lives at the bottom:
+old client vs new server, new client vs old server, and a mid-run
+epoch bump — both directions must keep ingest and param pulls
+flowing against a pre-epoch build."""
 
 import json
+import pickle
 import socket as socket_mod
 import threading
 import time
@@ -13,7 +19,8 @@ import numpy as np
 import pytest
 
 from ape_x_dqn_tpu.comm.socket_transport import (
-    SocketIngestServer, SocketTransport)
+    MSG_HELLO, MSG_HELLO_ACK, MSG_PARAMS, MSG_PARAMS_REQ,
+    SocketIngestServer, SocketTransport, _recv_msg, _send_msg)
 from ape_x_dqn_tpu.configs import ObsConfig
 from ape_x_dqn_tpu.obs.core import build_obs
 from ape_x_dqn_tpu.obs.fleet import (
@@ -255,6 +262,116 @@ def test_old_client_new_server_drops_telemetry_cleanly():
         assert client.send_telemetry({"peer": PEER, "seq": 0}) is False
         assert client.telemetry_frames_out == 0
         assert server.telemetry_frames == 0
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_epoch_interop_old_client_new_server():
+    """Pre-epoch client build: never hellos, sends an EMPTY
+    MSG_PARAMS_REQ. The new server must reply the legacy raw pickle
+    (no versioned header) so the old build's pickle.loads keeps
+    working — and experience from the same build keeps ingesting."""
+    server = SocketIngestServer("127.0.0.1", 0, epoch=77,
+                                param_wire_dtype="float32")
+    server.publish_params({"w": np.float32(1.5)}, 4)
+    sock = socket_mod.create_connection(("127.0.0.1", server.port))
+    try:
+        _send_msg(sock, MSG_PARAMS_REQ, b"")  # the old build's request
+        mtype, payload = _recv_msg(sock)
+        assert mtype == MSG_PARAMS
+        params, version = pickle.loads(bytes(payload))  # raw legacy blob
+        assert version == 4 and params["w"] == np.float32(1.5)
+    finally:
+        sock.close()
+        server.stop()
+
+
+def _old_param_server(listener, params, version, stop):
+    """A pre-epoch server: acks hellos WITHOUT an epoch field and
+    answers every MSG_PARAMS_REQ with the legacy raw pickle,
+    ignoring the request payload it does not understand."""
+    blob = pickle.dumps((params, version))
+    conns = []
+    listener.settimeout(0.2)
+    while not stop.is_set():
+        try:
+            conn, _ = listener.accept()
+        except socket_mod.timeout:
+            continue
+        except OSError:
+            return
+        conns.append(conn)
+
+        def serve(c=conn):
+            try:
+                while True:
+                    msg = _recv_msg(c)
+                    if msg is None:
+                        return
+                    mtype, _payload = msg
+                    if mtype == MSG_HELLO:
+                        _send_msg(c, MSG_HELLO_ACK,
+                                  json.dumps({"codec": "raw"}).encode())
+                    elif mtype == MSG_PARAMS_REQ:
+                        _send_msg(c, MSG_PARAMS, blob)
+            except (OSError, ValueError):
+                return
+
+        threading.Thread(target=serve, daemon=True).start()
+
+
+def test_epoch_interop_new_client_old_server():
+    """New client against a pre-epoch server: the JSON request payload
+    is ignored, the raw-pickle reply parses through the same path,
+    the epoch stays unknown (-1, no spurious epoch-change events),
+    and every pull ships the full blob (no 'unchanged' economy)."""
+    listener = socket_mod.socket(socket_mod.AF_INET,
+                                 socket_mod.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(4)
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_old_param_server,
+        args=(listener, {"w": 3.0}, 9, stop), daemon=True)
+    t.start()
+    client = SocketTransport("127.0.0.1", listener.getsockname()[1],
+                             params_push=True)  # offer ignored by old
+    try:
+        for _ in range(2):  # EVERY pull is a full blob against old
+            p, v = client.get_params()
+            assert p == {"w": 3.0} and v == 9
+        assert client.param_unchanged == 0
+        assert client.epoch == -1 and client.epoch_changes == 0
+        assert client.param_epoch == -1
+    finally:
+        stop.set()
+        client.close()
+        listener.close()
+        t.join(timeout=2)
+
+
+def test_epoch_interop_mid_run_bump_keeps_ingest_flowing():
+    """bump_epoch() on a LIVE server (config repush, failover drill):
+    connected clients observe exactly one epoch change through their
+    next pull, and experience ingest never skips a beat."""
+    server = SocketIngestServer("127.0.0.1", 0, epoch=10)
+    server.publish_params({"w": 0.0}, 0)
+    client = SocketTransport("127.0.0.1", server.port)
+    try:
+        client.send_experience(_experience_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+        p, _ = client.get_params()
+        assert p is not None and client.epoch == 10
+
+        server.bump_epoch()
+        p, v = client.get_params()  # epoch mismatch: full reply
+        assert p == {"w": 0.0} and v == 0
+        assert client.epoch == 11 and client.epoch_changes == 1
+        # the experience connection survived the bump untouched
+        client.send_experience(_experience_batch(seed=1))
+        assert server.recv_experience(timeout=5.0) is not None
+        assert client.reconnects == 0
     finally:
         client.close()
         server.stop()

@@ -357,7 +357,12 @@ def test_driver_stalled_actor_raises_attributed(monkeypatch, tmp_path):
     trace = str(tmp_path / "trace.json")
     jsonl = str(tmp_path / "m.jsonl")
     cfg = get_config("cartpole_smoke").replace(
-        actors=ActorConfig(num_actors=1, base_eps=0.6, ingest_batch=16),
+        # supervise=False: this test pins the legacy fatal path (wedged
+        # actor -> attributed StallError). With supervision on (the
+        # default) the supervisor restarts then quarantines the slot
+        # instead of raising — that path is tests/test_chaos.py's.
+        actors=ActorConfig(num_actors=1, base_eps=0.6, ingest_batch=16,
+                           supervise=False),
         replay=ReplayConfig(kind="prioritized", capacity=2048,
                             min_fill=64),
         learner=LearnerConfig(batch_size=32, n_step=3,

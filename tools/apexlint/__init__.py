@@ -1,12 +1,14 @@
 """apexlint: project-native static analysis for the Ape-X runtime.
 
-Four stdlib-only AST checkers over the package source (no imports of
+Five stdlib-only AST checkers over the package source (no imports of
 the code under analysis, no third-party deps):
 
 - guarded-by   lock discipline for `# guarded-by: <lock>` attributes
 - jit-purity   no host effects reachable from jax.jit boundaries
 - wire-protocol every MSG_* handled in every dispatch chain
 - obs-names    emitted instruments <-> obs/report.py table, both ways
+- retry-annotation swallowed socket errors in comm/runtime must emit
+  an obs counter/accounting bump or carry `# apexlint: lossy(reason)`
 
 CLI: `python -m tools.apexlint ape_x_dqn_tpu/ [--format=json]`
 exits 0 only with zero unwaived findings; tests/test_apexlint.py runs
@@ -20,7 +22,7 @@ from __future__ import annotations
 import os
 
 from tools.apexlint import (
-    guarded_by, jit_purity, obs_names, wire_protocol)
+    guarded_by, jit_purity, obs_names, retry_annotation, wire_protocol)
 from tools.apexlint.common import CheckResult, Finding, ModuleSource
 
 __all__ = ["CheckResult", "Finding", "ModuleSource", "run",
@@ -52,6 +54,7 @@ def run(package_dir: str,
     fold("guarded-by", guarded_by.check_paths(paths))
     fold("jit-purity", jit_purity.check_paths(paths))
     fold("wire-protocol", wire_protocol.check_paths(paths))
+    fold("retry-annotation", retry_annotation.check_paths(paths))
     if report_path is None:
         candidate = os.path.join(package_dir, "obs", "report.py")
         report_path = candidate if os.path.exists(candidate) else None

@@ -1,0 +1,58 @@
+"""Standalone chaos proxy: `python -m tools.chaos --listen 7001
+--connect learner-host:7000 --garble 0.01 --delay 0.005`.
+
+Point actor hosts at the proxy's listen port instead of the learner
+and watch the run's obs artifacts attribute every injected fault
+(wire_decode_errors, peer_disconnects, reconnect latencies). SIGINT
+prints the fault stats and exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from tools.chaos.proxy import ChaosProxy
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--listen", type=int, required=True,
+                    help="local port to accept actor-host connections on")
+    ap.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="upstream learner ingest address")
+    ap.add_argument("--drop", type=float, default=0.0)
+    ap.add_argument("--delay", type=float, default=0.0)
+    ap.add_argument("--truncate", type=float, default=0.0)
+    ap.add_argument("--garble", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cut-every", type=float, default=0.0,
+                    help="seconds between cutting all live connections "
+                         "(0 = never): the periodic learner-blip drill")
+    args = ap.parse_args(argv)
+    host, port = args.connect.rsplit(":", 1)
+    proxy = ChaosProxy(host, int(port), listen_port=args.listen,
+                       drop_rate=args.drop, delay_s=args.delay,
+                       truncate_rate=args.truncate,
+                       garble_rate=args.garble, seed=args.seed)
+    print(f"chaos proxy: :{proxy.port} -> {host}:{port}", flush=True)
+    try:
+        last_cut = time.monotonic()
+        while True:
+            time.sleep(0.5)
+            if args.cut_every > 0 \
+                    and time.monotonic() - last_cut >= args.cut_every:
+                n = proxy.cut()
+                last_cut = time.monotonic()
+                print(f"chaos proxy: cut {n} sockets", flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        proxy.stop()
+        print(f"chaos proxy stats: {proxy.stats}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,120 @@
+"""Process / thread / wire-frame fault primitives for the chaos lane.
+
+Everything here is deliberate damage with a narrow blast radius:
+`kill_process` only signals a PID the caller spawned, `ThreadWedge`
+only wedges a thread that opted in by calling its `checkpoint()`, and
+the frame corrupters build bad BYTES for a test to feed a server —
+they never touch live state.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import struct
+import threading
+import zlib
+
+# mirrors comm/socket_transport._HDR — duplicated on purpose: the
+# chaos tools must not import the code under test (a broken transport
+# module would take its own fault injector down with it)
+_HDR = struct.Struct("<IBIQ")
+_MAGIC = 0x41504558  # 'APEX'
+
+
+def kill_process(proc_or_pid, sig: int = signal.SIGKILL) -> None:
+    """SIGKILL (default) a child process: the 'actor host died' /
+    'learner died' fault. Accepts a multiprocessing.Process,
+    subprocess.Popen, or bare pid."""
+    pid = getattr(proc_or_pid, "pid", proc_or_pid)
+    if pid is None:
+        return
+    try:
+        os.kill(int(pid), sig)
+    except (ProcessLookupError, PermissionError):
+        pass  # already gone (the fault raced the exit): nothing to do
+
+
+class ThreadWedge:
+    """Cooperative thread wedge: a worker that calls `checkpoint()`
+    inside its loop freezes there while the wedge is engaged — the
+    'wedged but not dead' fault a heartbeat watchdog must attribute
+    (a SIGKILL test can't produce this shape: dead threads close
+    sockets; wedged ones just go silent)."""
+
+    def __init__(self):
+        self._gate = threading.Event()
+        self._gate.set()  # open = not wedged
+
+    def engage(self) -> None:
+        self._gate.clear()
+
+    def release(self) -> None:
+        self._gate.set()
+
+    @property
+    def engaged(self) -> bool:
+        return not self._gate.is_set()
+
+    def checkpoint(self, timeout: float | None = None) -> None:
+        """Call from the worker under test: blocks while engaged."""
+        self._gate.wait(timeout)
+
+
+def frame(mtype: int, payload: bytes) -> bytes:
+    """A well-formed wire frame (the control for the corrupters)."""
+    return _HDR.pack(_MAGIC, mtype, zlib.crc32(payload) & 0xFFFFFFFF,
+                     len(payload)) + payload
+
+
+def truncate(data: bytes, rng: random.Random | None = None) -> bytes:
+    """Cut a frame at a random interior byte (short read shape)."""
+    rng = rng or random.Random(0)
+    if len(data) < 2:
+        return b""
+    return data[:rng.randrange(1, len(data))]
+
+
+def garble(data: bytes, rng: random.Random | None = None,
+           flips: int = 1) -> bytes:
+    """Flip bits at random offsets (payload/header corruption)."""
+    rng = rng or random.Random(0)
+    out = bytearray(data)
+    for _ in range(max(flips, 1)):
+        out[rng.randrange(len(out))] ^= 1 << rng.randrange(8)
+    return bytes(out)
+
+
+def corrupt_frame(mtype: int, payload: bytes, mode: str,
+                  rng: random.Random | None = None) -> bytes:
+    """One corrupted wire frame by failure mode:
+
+    bad-magic   header magic is wrong (framing rejects immediately)
+    bad-crc     crc does not match the payload (checksum rejects)
+    short-len   header promises more payload bytes than follow
+    truncated   frame cut mid-payload
+    garbled     random bit flips anywhere in the frame
+    """
+    rng = rng or random.Random(0)
+    good = frame(mtype, payload)
+    if mode == "bad-magic":
+        return _HDR.pack(0xDEADBEEF, mtype,
+                         zlib.crc32(payload) & 0xFFFFFFFF,
+                         len(payload)) + payload
+    if mode == "bad-crc":
+        return _HDR.pack(_MAGIC, mtype,
+                         (zlib.crc32(payload) ^ 0x1) & 0xFFFFFFFF,
+                         len(payload)) + payload
+    if mode == "short-len":
+        return _HDR.pack(_MAGIC, mtype, zlib.crc32(payload) & 0xFFFFFFFF,
+                         len(payload) + 64) + payload
+    if mode == "truncated":
+        return truncate(good, rng)
+    if mode == "garbled":
+        return garble(good, rng, flips=3)
+    raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+CORRUPTION_MODES = ("bad-magic", "bad-crc", "short-len", "truncated",
+                    "garbled")

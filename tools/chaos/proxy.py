@@ -1,0 +1,194 @@
+"""ChaosProxy: byte-level TCP fault-injection forwarder.
+
+Sits between a SocketTransport client and a SocketIngestServer (or any
+TCP pair), forwarding both directions chunk by chunk. Faults apply per
+forwarded chunk, driven by a seeded RNG so a failing soak reproduces:
+
+    drop_rate      silently discard the chunk (downstream sees a gap —
+                   which at the TCP layer means the stream desyncs and
+                   the receiver's framing check kills the connection)
+    delay_s        sleep before forwarding (latency / wedged-link shape)
+    truncate_rate  forward a random prefix then CLOSE the connection
+                   (mid-frame cut: the receiver gets a short read)
+    garble_rate    flip bits in the chunk before forwarding (payload
+                   corruption: crc/framing checks must catch it)
+
+`cut()` closes every live connection at once without stopping the
+listener — the canonical "learner blip" for reconnect tests.
+`set_fault(...)` swaps rates at runtime, so one proxy can run a clean
+warmup phase and a chaotic middle phase in the same soak.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+
+
+def _shutdown_close(s: socket.socket) -> None:
+    """shutdown() BEFORE close(): a bare close of a socket another
+    pump thread is blocked in recv() on neither wakes that thread nor
+    reliably races the FIN out first — the downstream peer can then
+    sit in a full recv-timeout stall instead of seeing the cut
+    immediately. shutdown tears both directions down synchronously."""
+    try:
+        s.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass  # already dead: close still reclaims the fd
+    try:
+        s.close()
+    except OSError:
+        pass
+
+
+class ChaosProxy:
+    """TCP forwarder with per-chunk fault injection.
+
+    One proxy serves many client connections (each gets its own
+    upstream connection and a forwarder thread per direction). All
+    fault state is read per chunk, so set_fault/cut take effect
+    immediately on live traffic."""
+
+    def __init__(self, connect_host: str, connect_port: int,
+                 listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 drop_rate: float = 0.0, delay_s: float = 0.0,
+                 truncate_rate: float = 0.0, garble_rate: float = 0.0,
+                 seed: int = 0, chunk: int = 65536):
+        self._upstream = (connect_host, connect_port)
+        self._rng = random.Random(seed)
+        self._chunk = chunk
+        self._lock = threading.Lock()
+        # fault rates, swappable at runtime  (guarded-by: _lock)
+        self._drop = drop_rate
+        self._delay = delay_s
+        self._truncate = truncate_rate
+        self._garble = garble_rate
+        # live sockets for cut()  (guarded-by: _lock)
+        self._live: list[socket.socket] = []
+        self._stats = {"chunks": 0, "dropped": 0, "delayed": 0,
+                       "truncated": 0, "garbled": 0,
+                       "connections": 0, "cuts": 0}  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, listen_port))
+        self._listener.listen(32)
+        self._listener.settimeout(0.2)
+        self.port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="chaos-accept", daemon=True)
+        self._accept_thread.start()
+
+    # -- control surface ---------------------------------------------------
+
+    def set_fault(self, drop_rate: float | None = None,
+                  delay_s: float | None = None,
+                  truncate_rate: float | None = None,
+                  garble_rate: float | None = None) -> None:
+        """Swap fault rates at runtime; None leaves a rate unchanged."""
+        with self._lock:
+            if drop_rate is not None:
+                self._drop = drop_rate
+            if delay_s is not None:
+                self._delay = delay_s
+            if truncate_rate is not None:
+                self._truncate = truncate_rate
+            if garble_rate is not None:
+                self._garble = garble_rate
+
+    def clean(self) -> None:
+        """Disable all faults (forward transparently)."""
+        self.set_fault(0.0, 0.0, 0.0, 0.0)
+
+    def cut(self) -> int:
+        """Close every live connection (both sides) without stopping
+        the listener: the canonical learner/link blip. Returns how many
+        sockets were cut."""
+        with self._lock:
+            live, self._live = self._live, []
+            self._stats["cuts"] += 1
+        for s in live:
+            _shutdown_close(s)
+        return len(live)
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._accept_thread.join(timeout=2)
+        self.cut()
+        self._listener.close()
+
+    # -- internals ---------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                server = socket.create_connection(self._upstream,
+                                                  timeout=5.0)
+            except OSError:
+                client.close()  # upstream down: refuse by closing
+                continue
+            for s in (client, server):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._live += [client, server]
+                self._stats["connections"] += 1
+            for src, dst, tag in ((client, server, "c2s"),
+                                  (server, client, "s2c")):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 name=f"chaos-{tag}", daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                data = src.recv(self._chunk)
+                if not data:
+                    return
+                with self._lock:
+                    self._stats["chunks"] += 1
+                    drop, delay = self._drop, self._delay
+                    trunc, garble = self._truncate, self._garble
+                    roll = self._rng.random()
+                    cut_at = (self._rng.randrange(len(data))
+                              if len(data) > 1 else 0)
+                    flip = self._rng.randrange(len(data))
+                if delay > 0:
+                    with self._lock:
+                        self._stats["delayed"] += 1
+                    time.sleep(delay)
+                if roll < drop:
+                    with self._lock:
+                        self._stats["dropped"] += 1
+                    continue
+                if roll < drop + trunc:
+                    with self._lock:
+                        self._stats["truncated"] += 1
+                    dst.sendall(data[:cut_at])
+                    return  # mid-frame cut, then drop the connection
+                if roll < drop + trunc + garble:
+                    with self._lock:
+                        self._stats["garbled"] += 1
+                    mangled = bytearray(data)
+                    mangled[flip] ^= 0xFF
+                    data = bytes(mangled)
+                dst.sendall(data)
+        except OSError:
+            return  # either side died (or cut()): the pair tears down
+        finally:
+            for s in (src, dst):
+                _shutdown_close(s)
+            with self._lock:
+                self._live = [s for s in self._live
+                              if s is not src and s is not dst]
