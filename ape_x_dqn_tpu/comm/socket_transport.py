@@ -1023,11 +1023,19 @@ class SocketIngestServer:
                         ack["telemetry"] = True
                     if wants_push:
                         ack["params_push"] = True
+                    # ack FIRST, subscribe after: if a publish is already
+                    # pending, a push thread registered before the ack is
+                    # on the wire could win the conn's send lock and make
+                    # MSG_PARAMS_PUSH the connection's first frame — the
+                    # client reads that as a failed negotiation, degrades
+                    # to raw, and never drains the pushes, eventually
+                    # wedging the push thread in sendall on a full window
+                    self._send_on(conn, MSG_HELLO_ACK,
+                                  json.dumps(ack).encode())
+                    if wants_push:
                         with self._conns_lock:
                             self._push_subs[id(conn)] = conn
                         self._ensure_push_thread()
-                    self._send_on(conn, MSG_HELLO_ACK,
-                                  json.dumps(ack).encode())
                 elif mtype == MSG_TELEMETRY:
                     # per-peer obs snapshot: remember which peer this
                     # connection is (disconnect attribution), count the

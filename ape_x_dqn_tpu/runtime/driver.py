@@ -219,6 +219,10 @@ class ApexDriver:
         self._slot_threads: dict[int, threading.Thread] = {}  # guarded-by: _lock
         self._slot_budget: dict[int, int] = {}  # guarded-by: _lock
         self._slot_actor_obj: dict[int, Any] = {}  # guarded-by: _lock
+        # frames produced by FINISHED attempts of the slot's current
+        # generation (crash-restarts); the live attempt's count lives on
+        # the actor object itself
+        self._slot_done: dict[int, int] = {}  # guarded-by: _lock
         self._slot_restarts: dict[int, int] = {}  # guarded-by: _lock
         self._quarantined: set[int] = set()  # guarded-by: _lock
         self._peer_quarantined: set[str] = set()  # guarded-by: _lock
@@ -377,6 +381,7 @@ class ApexDriver:
             self._slot_stops[i] = ev
             self._slot_threads[i] = t
             self._slot_budget[i] = max_frames
+            self._slot_done[i] = 0  # fresh generation, fresh accounting
         t.start()
         return t
 
@@ -443,7 +448,16 @@ class ApexDriver:
             except Exception as e:
                 # frames the crashed actor already ingested stay counted;
                 # only its unshipped tail is lost
-                remaining -= actor.frames if actor is not None else 0
+                done = actor.frames if actor is not None else 0
+                remaining -= done
+                with self._lock:
+                    # credit the attempt's frames to the slot ONLY if this
+                    # thread is still the slot's current generation — a
+                    # superseded thread crashing late must not corrupt its
+                    # replacement's budget accounting
+                    if self._slot_stops.get(i) is stop:
+                        self._slot_done[i] = \
+                            self._slot_done.get(i, 0) + done
                 # a crash with no budget left (frames or restarts) is an
                 # error, not a "recovered" restart — e.g. the final
                 # force-ship failing after all frames were stepped
@@ -499,16 +513,31 @@ class ApexDriver:
         """Restart or quarantine one wedged LOCAL actor slot."""
         with self._lock:
             if i in self._quarantined:
-                return
-            used = self._slot_restarts.get(i, 0)
-            exhausted = used >= self.cfg.actors.supervisor_max_restarts
-            if exhausted:
-                self._quarantined.add(i)
+                already = True
             else:
-                self._slot_restarts[i] = used + 1
-            old_ev = self._slot_stops.get(i)
-            actor = self._slot_actor_obj.pop(i, None)
-            budget = self._slot_budget.get(i, 0)
+                already = False
+                used = self._slot_restarts.get(i, 0)
+                exhausted = used >= self.cfg.actors.supervisor_max_restarts
+                if exhausted:
+                    self._quarantined.add(i)
+                    # drop the wedged thread from liveness bookkeeping:
+                    # a quarantined slot must not keep run()'s
+                    # any(is_alive) drain check true forever, or the
+                    # degraded-but-terminating contract becomes a hang
+                    self._slot_threads.pop(i, None)
+                    old_ev = self._slot_stops.pop(i, None)
+                else:
+                    self._slot_restarts[i] = used + 1
+                    old_ev = self._slot_stops.get(i)
+                actor = self._slot_actor_obj.pop(i, None)
+                budget = self._slot_budget.get(i, 0)
+                done_prior = self._slot_done.get(i, 0)
+        if already:
+            # a superseded thread un-wedged long enough to beat again:
+            # re-clear so the fallthrough check_stalled() can't convert
+            # a quarantine into a fatal StallError
+            self.obs.clear(f"actor-{i}")
+            return
         if old_ev is not None:
             old_ev.set()  # superseded generation exits if it un-wedges
         if exhausted:
@@ -521,12 +550,16 @@ class ApexDriver:
                 "budget (%d) — quarantined; the run continues without it",
                 i, self.cfg.actors.supervisor_max_restarts)
             return
-        done = 0
+        # remaining = generation budget minus EVERY frame the slot
+        # already produced this generation: crash-restart attempts that
+        # ended before this supersession (_slot_done) plus the wedged
+        # current attempt's count
+        done = done_prior
         if actor is not None:
             try:
-                done = int(actor.frames)
+                done += int(actor.frames)
             except (TypeError, ValueError, AttributeError):
-                done = 0
+                pass
         remaining = max(budget - done, 0)
         self.obs.count("supervisor_restarts")
         with self._lock:

@@ -250,6 +250,41 @@ def test_params_push_delivery():
         srv.stop()
 
 
+def test_pending_publish_does_not_preempt_hello_ack():
+    """A publish already pending at connect time must not let the push
+    thread win the conn's send lock and ship MSG_PARAMS_PUSH as the
+    connection's FIRST frame: the client reads the first frame as the
+    hello ack, so a push there silently degrades negotiation to raw
+    and leaves the server pushing blobs nobody drains. The server
+    therefore sends the ack BEFORE subscribing the conn — and the late
+    subscriber still receives the pending publish."""
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=11)
+    srv.publish_params({"w": 5}, 1)  # push pending before any connect
+    ack_saw_sub = []
+    real_send_on = srv._send_on
+
+    def spy(conn, mtype, payload):
+        if mtype == st.MSG_HELLO_ACK:
+            with srv._conns_lock:
+                ack_saw_sub.append(id(conn) in srv._push_subs)
+        return real_send_on(conn, mtype, payload)
+
+    srv._send_on = spy
+    client = _client(srv.port, params_push=True)
+    try:
+        client.send_experience(_batch())  # connect + negotiate
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert client.params_push_negotiated  # ack was the first frame
+        assert ack_saw_sub == [False]  # subscribed only after the ack
+        # the pending publish still reaches the late subscriber
+        assert _wait(lambda: client.param_pushes_in >= 1)
+        p, v = client.poll_pushed_params()
+        assert p == {"w": 5} and v == 1
+    finally:
+        client.close()
+        srv.stop()
+
+
 def test_pull_failure_bumps_param_pull_errors():
     srv = SocketIngestServer("127.0.0.1", 0)
     port = srv.port
@@ -511,6 +546,75 @@ def test_supervisor_quarantines_after_restart_budget(supervised_driver):
             "actor_quarantines").value == before + 1
     finally:
         driver._spawn_actor_slot = real_spawn
+
+
+def test_quarantine_releases_slot_liveness(supervised_driver):
+    """Quarantining a wedged slot must also drop its thread from the
+    liveness bookkeeping: run()'s drain check is any(is_alive) over
+    _slot_threads, and a wedged thread never finishes — left in the
+    dict it would turn the documented degraded-but-terminating path
+    into an unattributed infinite hang (the quarantine already cleared
+    the heartbeat, so check_stalled can't fire either)."""
+    driver = supervised_driver
+    wedged_stop = threading.Event()
+    t = threading.Thread(target=wedged_stop.wait, daemon=True)
+    t.start()
+    driver._slot_threads[0] = t
+    driver._slot_stops[0] = wedged_stop
+    driver._slot_restarts[0] = \
+        driver.cfg.actors.supervisor_max_restarts  # budget burned
+    try:
+        _age_heartbeat(driver, "actor-0")
+        driver._supervise_tick()
+        assert 0 in driver._quarantined
+        # the wedged thread no longer counts toward the drain check
+        assert 0 not in driver._slot_threads
+        assert 0 not in driver._slot_stops
+        assert not any(th.is_alive() for th in driver._actor_threads()
+                       if th is t)
+        # its generation event was set so it exits if it ever un-wedges
+        assert wedged_stop.is_set()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        # a late beat from the superseded thread must not let the
+        # fallthrough check_stalled convert the quarantine to a raise
+        _age_heartbeat(driver, "actor-0")
+        driver._supervise_tick()
+        assert "actor-0" not in driver.obs.heartbeats.ages()
+    finally:
+        wedged_stop.set()
+        driver.obs.clear("actor-0")
+        driver._quarantined.discard(0)
+        driver._slot_restarts.pop(0, None)
+
+
+def test_supervisor_budget_counts_prior_attempts(supervised_driver):
+    """The remaining-budget estimate must subtract frames from EVERY
+    attempt of the slot's generation (_slot_done accumulates finished
+    crash-restart attempts), not just the wedged current attempt —
+    else a supervised restart over-produces frames."""
+    driver = supervised_driver
+
+    class _FakeActor:
+        frames = 40
+
+    spawned = []
+    real_spawn = driver._spawn_actor_slot
+    driver._spawn_actor_slot = \
+        lambda i, f, attempt0=0: spawned.append((i, f))
+    try:
+        driver._slot_restarts.pop(0, None)
+        driver._slot_budget[0] = 640
+        driver._slot_done[0] = 100  # earlier crash-restart attempts
+        driver._slot_actor_obj[0] = _FakeActor()
+        _age_heartbeat(driver, "actor-0")
+        driver._supervise_tick()
+        assert spawned == [(0, 640 - 100 - 40)]
+    finally:
+        driver._spawn_actor_slot = real_spawn
+        driver._slot_done.pop(0, None)
+        driver._slot_restarts.pop(0, None)
+        driver.obs.clear("actor-0")
 
 
 def test_supervisor_quarantines_stalled_remote_peer(supervised_driver):
