@@ -278,6 +278,34 @@ class ObsConfig:
     # disables the emitter thread (frames also require both wire ends
     # to negotiate the capability — an old peer degrades to none).
     telemetry_every_s: float = 2.0
+    # -- continuous perf plane (obs/profiling.py, ISSUE 8) --------------
+    # live roofline gauges (per-stage mfu / hbm_bw_frac / device_ms):
+    # default ON with obs — they reuse the block_until_ready sync
+    # points the span tracer already pays for, so they add no new
+    # device synchronization and touch no jit
+    profile_gauges: bool = True
+    # EXTRA sampling windows on paths that are otherwise async (the
+    # zero-copy ingest ship): default OFF — enabling inserts a
+    # block_until_ready every profile_window_every-th ship, trading a
+    # sliver of pipeline overlap for honest ingest device time
+    profile_windows: bool = False
+    profile_window_every: int = 16
+    # jit-compile interceptor (jit_compiles / jit_compile_ms counters
+    # + the cumulative compile_cache_entries gauge that monitors the
+    # XLA accumulation regime run_chunked.sh works around)
+    compile_telemetry: bool = True
+    # EWMA perf-regression engine: a rate window below perf_frac of
+    # its rolling baseline logs an attributed PerfDegradation event
+    # (warn-only — never raises, unlike the stall watchdog)
+    perf_regression: bool = True
+    perf_frac: float = 0.5
+    perf_ewma_alpha: float = 0.1
+    perf_min_samples: int = 8
+    perf_cooldown_s: float = 30.0
+    # MFU / bandwidth-fraction denominators; 0 = auto from
+    # jax.devices()[0].device_kind (obs/profiling.device_peaks)
+    device_peak_flops: float = 0.0
+    device_peak_bytes_per_s: float = 0.0
 
 
 @dataclass(frozen=True)

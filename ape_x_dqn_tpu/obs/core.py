@@ -49,9 +49,26 @@ class NullObs:
     enabled = False
     tracer = NULL_TRACER
     watchdog = None
+    profiler = None
+    perf = None
 
     def span(self, name: str, **args: Any):
         return NULL_TRACER.span(name)
+
+    def stage_window(self, stage: str, steps: int = 1):
+        return NULL_TRACER.span(stage)
+
+    def stage_attach(self, stage: str, steps: int = 1,
+                     compiled: Any = None, compile_fn=None) -> None:
+        pass
+
+    def stage_attached(self, stage: str) -> bool:
+        # True: disabled obs never wants the (compiling) attach path
+        return True
+
+    def perf_rate(self, name: str, value, step: int = 0,
+                  peer: str = "") -> None:
+        pass
 
     def mark(self, name: str, **args: Any) -> None:
         pass
@@ -173,6 +190,27 @@ class Obs:
             False if getattr(cfg, "jax_profile_dir", "") else None)
         self._prof_from = 0
         self._closed = False
+        # continuous perf plane (obs/profiling.py, ISSUE 8): roofline
+        # gauges + compile telemetry default-on with obs, the EWMA
+        # regression engine likewise; each is individually knob-gated.
+        # getattr defaults keep configs predating the knobs working.
+        from ape_x_dqn_tpu.obs import profiling
+
+        self.profiler = (profiling.StageProfiler(
+            self,
+            peak_flops=getattr(cfg, "device_peak_flops", 0.0),
+            peak_bw=getattr(cfg, "device_peak_bytes_per_s", 0.0))
+            if getattr(cfg, "profile_gauges", True) else None)
+        self._compile_telemetry = (
+            profiling.CompileTelemetry()
+            if getattr(cfg, "compile_telemetry", True) else None)
+        self.perf = (profiling.PerfMonitor(
+            self, metrics,
+            frac=getattr(cfg, "perf_frac", 0.5),
+            alpha=getattr(cfg, "perf_ewma_alpha", 0.1),
+            min_samples=getattr(cfg, "perf_min_samples", 8),
+            cooldown_s=getattr(cfg, "perf_cooldown_s", 30.0))
+            if getattr(cfg, "perf_regression", True) else None)
 
     # -- tracing -----------------------------------------------------------
 
@@ -249,6 +287,33 @@ class Obs:
     def observe_sample_ages(self, ages) -> None:
         self.observe_many("sample_age_steps", ages)
 
+    # -- continuous perf plane (obs/profiling.py) --------------------------
+
+    def stage_window(self, stage: str, steps: int = 1):
+        """Device-time attribution window around a block_until_ready-
+        bracketed stage dispatch; publishes the stage's mfu /
+        hbm_bw_frac / device_ms gauges on exit. No-op context when the
+        roofline gauges are knob-disabled."""
+        if self.profiler is None:
+            return NULL_TRACER.span(stage)
+        return self.profiler.window(stage, steps)
+
+    def stage_attach(self, stage: str, steps: int = 1,
+                     compiled: Any = None, compile_fn=None) -> None:
+        if self.profiler is not None:
+            self.profiler.attach(stage, steps, compiled=compiled,
+                                 compile_fn=compile_fn)
+
+    def stage_attached(self, stage: str) -> bool:
+        return self.profiler is None or self.profiler.attached(stage)
+
+    def perf_rate(self, name: str, value, step: int = 0,
+                  peer: str = "") -> None:
+        """Feed one throughput-rate sample to the EWMA regression
+        engine (warn-only PerfDegradation events)."""
+        if self.perf is not None:
+            self.perf.observe(name, value, step=step, peer=peer)
+
     # -- jax integration ---------------------------------------------------
 
     def log_compiled(self, tag: str, compiled) -> None:
@@ -289,6 +354,8 @@ class Obs:
         JSONL record (`span/<name>` dicts carry the stage-time
         breakdown obs/report.py prints)."""
         self.set_learner_step(step)
+        if self._compile_telemetry is not None:
+            self._compile_telemetry.publish_into(self)
         agg = self.tracer.aggregates()
         extra = {f"span/{name}": stats for name, stats in agg.items()}
         self.registry.publish(self.metrics, step, extra=extra)

@@ -238,6 +238,13 @@ class FleetAggregator:
             rate = st["rate"]
         obs.count("telemetry_frames")
         obs.gauge("fleet_peers", n_connected)
+        # per-peer perf-regression baseline (obs/profiling.PerfMonitor):
+        # a peer whose experience output collapses below its own EWMA
+        # baseline fires an attributed PerfDegradation record carrying
+        # the peer id — warn-only, distinct from the stall watchdog
+        if rate > 0.0:
+            obs.perf_rate("ingest_rows_per_s", rate, step=self._step(),
+                          peer=peer)
         # the peer itself heartbeats by sending frames at all; each
         # remote component re-beats at local_now - reported_age so the
         # driver's check_stalled() attributes a wedged REMOTE component

@@ -75,6 +75,40 @@ INSTRUMENTS = {
     "peer_stall_events": {"kind": "ctr"},
     "param_pull_errors": {"kind": "ctr"},
     "wire_decode_errors": {"kind": "ctr"},
+    # continuous perf plane (obs/profiling.py, ISSUE 8): live roofline
+    # gauges per stage (EWMA ms/dispatch + cost-analysis MFU and HBM
+    # bandwidth fractions; the compiler FLOP count under-reports convs
+    # on this backend, so mfu_* are lower bounds — see PERF.md)
+    "mfu_sample_k": {"kind": "gauge"},
+    "hbm_bw_frac_sample_k": {"kind": "gauge"},
+    "device_ms_sample_k": {"kind": "gauge"},
+    "mfu_learn_k": {"kind": "gauge"},
+    "hbm_bw_frac_learn_k": {"kind": "gauge"},
+    "device_ms_learn_k": {"kind": "gauge"},
+    "mfu_train": {"kind": "gauge"},
+    "hbm_bw_frac_train": {"kind": "gauge"},
+    "device_ms_train": {"kind": "gauge"},
+    "hbm_bw_frac_ingest": {"kind": "gauge"},
+    "device_ms_ingest": {"kind": "gauge"},
+    "ingest_ship_ms": {"kind": "gauge"},
+    # compile telemetry: per-publish compile deltas + the monotonic
+    # per-process executable count whose growth precedes the known XLA
+    # teardown SIGSEGV (tests/run_chunked.sh exists because of it)
+    "jit_compiles": {"kind": "ctr"},
+    "jit_compile_ms": {"kind": "ctr"},
+    "compile_cache_entries": {
+        "kind": "gauge",
+        "warn": ("value", 2000,
+                 "a long-lived process past ~2000 backend compiles is "
+                 "in the XLA accumulation regime that has segfaulted "
+                 "CPU clients at teardown — split the workload "
+                 "(run_chunked.sh) or hunt the shape churn")},
+    # perf-regression engine: EWMA throughput baselines + warn-only
+    # degradation events (each event is an attributed JSONL record)
+    "perf_degradations": {"kind": "ctr"},
+    "ewma_grad_steps_per_s": {"kind": "gauge"},
+    "ewma_env_fps": {"kind": "gauge"},
+    "ewma_ingest_rows_per_s": {"kind": "gauge"},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -104,6 +138,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     latest: dict[str, Any] = {}
     stalls: list[dict] = []
     disconnects: list[dict] = []
+    perf_events: list[dict] = []
     for rec in records:
         for k, v in rec.items():
             if v is not None:
@@ -116,6 +151,13 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         if rec.get("peer_disconnect") is not None:
             disconnects.append({"step": rec.get("step"),
                                 "peer": rec["peer_disconnect"]})
+        if rec.get("perf_degradation") is not None:
+            perf_events.append({"step": rec.get("step"),
+                                "name": rec["perf_degradation"],
+                                "peer": rec.get("perf_peer"),
+                                "value": rec.get("perf_value"),
+                                "baseline": rec.get("perf_baseline"),
+                                "frac": rec.get("perf_frac")})
     # fleet telemetry: `peer/<id>/<kind>/<name>` keys the aggregator
     # merges into the stream (obs/fleet.py) regroup into one dict per
     # peer — {"seq": n, "ctr": {...}, "gauge": {...}, "hist": {...},
@@ -136,6 +178,8 @@ def summarize(records: list[dict]) -> dict[str, Any]:
              if k.startswith("hist/") and isinstance(v, dict)}
     gauges = {k[len("gauge/"):]: v for k, v in latest.items()
               if k.startswith("gauge/")}
+    ctrs = {k[len("ctr/"):]: v for k, v in latest.items()
+            if k.startswith("ctr/")}
     hbm = {k[len("hbm/"):]: v for k, v in latest.items()
            if k.startswith("hbm/")}
     header_keys = ("run_name", "version", "sample_chunk",
@@ -155,10 +199,12 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "spans": spans,
         "hists": hists,
         "gauges": gauges,
+        "ctrs": ctrs,
         "hbm": hbm,
         "peers": peers,
         "disconnects": disconnects,
         "stalls": stalls,
+        "perf_events": perf_events,
     }
 
 
@@ -282,6 +328,86 @@ def _fmt_slo(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+# stage -> (mfu gauge, bw gauge, ewma-ms gauge, host span carrying the
+# stage's total wall time). The span totals give the honest device-time
+# SHARE (every window is block_until_ready-bracketed by contract);
+# the gauges give the per-dispatch roofline position.
+_ROOFLINE_STAGES = (
+    ("sample_k", "mfu_sample_k", "hbm_bw_frac_sample_k",
+     "device_ms_sample_k", "replay.sample"),
+    ("learn_k", "mfu_learn_k", "hbm_bw_frac_learn_k",
+     "device_ms_learn_k", "learner.learn"),
+    ("train", "mfu_train", "hbm_bw_frac_train",
+     "device_ms_train", "learner.train"),
+    ("ingest", None, "hbm_bw_frac_ingest",
+     "device_ms_ingest", "replay.add"),
+)
+
+
+def _fmt_roofline(summary: dict[str, Any]) -> list[str]:
+    """Live roofline (obs/profiling.py): per-stage EWMA dispatch time,
+    device-time share, and MFU / HBM-bandwidth fractions against the
+    detected chip peaks — the continuous version of PERF.md's one-off
+    roofline study. mfu_* are LOWER bounds (compiler FLOP counts omit
+    most conv FLOPs on this backend)."""
+    gauges = summary.get("gauges", {})
+    spans = summary.get("spans", {})
+    rows = []
+    for stage, mfu_k, bw_k, ms_k, span_name in _ROOFLINE_STAGES:
+        if ms_k not in gauges and (mfu_k is None
+                                   or mfu_k not in gauges):
+            continue
+        rows.append((stage,
+                     gauges.get(mfu_k) if mfu_k else None,
+                     gauges.get(bw_k), gauges.get(ms_k),
+                     float(spans.get(span_name, {}).get("total_s", 0.0))))
+    if not rows:
+        return []
+    # single-process runs carry no host spans; their stages share one
+    # dispatch cadence, so the EWMA-ms weights give the same share
+    if not any(r[4] for r in rows):
+        rows = [(st, mfu, bw, ms, float(ms or 0.0))
+                for st, mfu, bw, ms, _ in rows]
+    grand = sum(r[4] for r in rows) or 1.0
+    lines = ["roofline (live gauges; mfu is a lower bound — see "
+             "PERF.md):",
+             f"  {'stage':<12} {'dev_ms(ewma)':>13} {'time_share':>11} "
+             f"{'mfu':>8} {'hbm_bw':>8}"]
+    for stage, mfu, bw, ms, total_s in rows:
+        ms_s = f"{float(ms):.3f}" if ms is not None else "-"
+        mfu_s = f"{float(mfu):.2%}" if mfu is not None else "-"
+        bw_s = f"{float(bw):.2%}" if bw is not None else "-"
+        lines.append(f"  {stage:<12} {ms_s:>13} "
+                     f"{total_s / grand:>10.1%} {mfu_s:>8} {bw_s:>8}")
+    ctrs = summary.get("ctrs", {})
+    n = ctrs.get("jit_compiles")
+    if n is not None:
+        ms = ctrs.get("jit_compile_ms", 0.0)
+        entries = gauges.get("compile_cache_entries")
+        lines.append(
+            f"  compile telemetry: {_n(n)} backend compiles, "
+            f"{float(ms):.0f} ms total, process cache entries="
+            f"{_n(entries)}")
+    return lines
+
+
+def _fmt_perf_events(summary: dict[str, Any]) -> list[str]:
+    """PerfDegradation events (warn-only EWMA regression engine), with
+    peer attribution when the baseline was a fleet peer's."""
+    events = summary.get("perf_events", [])
+    if not events:
+        return []
+    lines = [f"perf-degradation events: {len(events)} (warn-only; the "
+             f"run continued)"]
+    for e in events:
+        who = f" peer={e['peer']}" if e.get("peer") else ""
+        lines.append(
+            f"  step={_n(e['step'])} {e['name']}{who}: "
+            f"{_n(e['value'])} fell below {_n(e['frac'])}x baseline "
+            f"{_n(e['baseline'])}")
+    return lines
+
+
 def _fmt_peers(summary: dict[str, Any]) -> list[str]:
     """Per-peer fleet telemetry: one block per remote actor host with
     its heartbeat ages, ingest rate, stage-time breakdown, and any
@@ -354,6 +480,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if summary["spans"]:
         lines.append("")
         lines.extend(_fmt_spans(summary["spans"]))
+    roofline_lines = _fmt_roofline(summary)
+    if roofline_lines:
+        lines.append("")
+        lines.extend(roofline_lines)
     if summary["hists"]:
         lines.append("")
         lines.append("staleness / distribution percentiles:")
@@ -371,6 +501,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if peer_lines:
         lines.append("")
         lines.extend(peer_lines)
+    perf_lines = _fmt_perf_events(summary)
+    if perf_lines:
+        lines.append("")
+        lines.extend(perf_lines)
     if summary["hbm"]:
         lines.append("")
         lines.append("compiled memory (XLA memory_analysis, bytes):")

@@ -17,6 +17,7 @@ Threads:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -57,6 +58,9 @@ from ape_x_dqn_tpu.utils.metrics import (
     Metrics, Throughput, log_run_header)
 from ape_x_dqn_tpu.utils.misc import next_pow2
 from ape_x_dqn_tpu.utils.rng import component_key
+
+# reusable no-op context for the unprofiled (default) ship path
+_NULL_CM = contextlib.nullcontext()
 
 
 def build_prioritized_replay(cfg: RunConfig, spec, capacity: int,
@@ -204,6 +208,17 @@ class ApexDriver:
         self.episode_returns: deque[float] = deque(maxlen=200)  # guarded-by: _lock
         self.frames = Throughput(window_s=30.0)
         self.grad_steps = Throughput(window_s=30.0)
+        # rows actually landed in replay (post-drop, post-coalesce) —
+        # the perf-regression engine's local ingest baseline
+        self.ingest_rows = Throughput(window_s=30.0)
+        # sampled block_until_ready windows on the ingest ship path are
+        # OFF by default: the zero-copy stager's whole point is decode/
+        # transfer overlap, and an every-dispatch sync would serialize it
+        ocfg = getattr(cfg, "obs", None)
+        self._ship_window_every = (
+            max(getattr(ocfg, "profile_window_every", 16), 1)
+            if getattr(ocfg, "profile_windows", False) else 0)
+        self._ship_seq = 0  # ingest thread only
         self._frames_total = 0  # guarded-by: _lock
         self._grad_steps_total = 0
         self.actor_errors: list[tuple[int, Exception]] = []  # guarded-by: _lock
@@ -672,6 +687,8 @@ class ApexDriver:
                            self._stager.occupancy())
             self.obs.gauge("ingest_decode_ms",
                            self._stager.last_put_decode_ms)
+            self.obs.gauge("ingest_ship_ms",
+                           self._stager.last_ship_ms)
         else:
             self._stage.append(batch)
             self._stage_n += n
@@ -711,13 +728,27 @@ class ApexDriver:
             else ()
         if tags:
             span_args["batch_ids"] = [t[1] for t in tags[:MAX_SPAN_IDS]]
-        with self._state_lock:
-            with self.obs.span("replay.add", **span_args):
-                if g > 1:
-                    self.state = self.learner.add_many(self.state, staged,
-                                                       pris)
-                else:
-                    self.state = self.learner.add(self.state, staged, pris)
+        # 1-in-N profiled ship (ObsConfig.profile_windows): bracket the
+        # dispatch with block_until_ready so the "ingest" roofline stage
+        # sees device time, not enqueue time. Off by default — syncing
+        # here defeats the stager's decode/transfer overlap
+        self._ship_seq += 1
+        windowed = (self._ship_window_every
+                    and self._ship_seq % self._ship_window_every == 0)
+        win = (self.obs.stage_window("ingest", count) if windowed
+               else _NULL_CM)
+        with win:
+            with self._state_lock:
+                with self.obs.span("replay.add", **span_args):
+                    if g > 1:
+                        self.state = self.learner.add_many(self.state,
+                                                           staged, pris)
+                    else:
+                        self.state = self.learner.add(self.state, staged,
+                                                      pris)
+            if windowed:
+                jax.block_until_ready(self.state.replay)
+        self.ingest_rows.add(count * self._unit_items)
         with self._lock:
             self._replay_filled = min(
                 self._replay_filled + count * self._unit_items,
@@ -741,6 +772,7 @@ class ApexDriver:
         with self._state_lock:
             with self.obs.span("replay.add", units=count):
                 self.state = self.learner.add(self.state, items, pris)
+        self.ingest_rows.add(count * self._unit_items)
         with self._lock:
             self._replay_filled = min(
                 self._replay_filled + count * self._unit_items,
@@ -865,6 +897,16 @@ class ApexDriver:
             c_many = cls.train_many.lower(learner, self.state,
                                           chunk).compile()
             self.obs.log_compiled("train_many", c_many)
+            self.obs.stage_attach("train", chunk, compiled=c_many)
+        else:
+            self.obs.stage_attach("train", 1, compiled=c_step)
+        # roofline attribution (obs/profiling.py): the warmed executables
+        # already carry cost_analysis — attach them so the learner-loop
+        # stage windows and the sampled ingest windows can turn wall time
+        # into MFU / HBM-bandwidth fractions. One "ingest" step == one
+        # staging unit, so bytes scale with the coalesce width shipped
+        self.obs.stage_attach("ingest", self.dp * self._stage_chunk,
+                              compiled=c_add)
         # the inference server's first forward compile otherwise exceeds
         # the actor query timeout on TPU (observed live); vector actors
         # hit the envs_per_actor bucket on their very first query. A
@@ -960,16 +1002,21 @@ class ApexDriver:
             done = self._grad_steps_total
             k = chunk if chunk <= max_grad_steps - done else 1
             with self._state_lock:
-                with self.obs.span("learner.train", k=k):
-                    if k > 1:
-                        self.state, m = self.learner.train_many(
-                            self.state, k)
-                    else:
-                        self.state, m = self.learner.train_step(self.state)
-                    if self.obs.enabled:
-                        # honest host timing under async dispatch; only
-                        # paid when observability is on
-                        m = jax.block_until_ready(m)
+                # the stage window rides the span's existing
+                # block_until_ready sync point — no extra sync is added
+                # for the roofline gauges on the fused train path
+                with self.obs.stage_window("train", k):
+                    with self.obs.span("learner.train", k=k):
+                        if k > 1:
+                            self.state, m = self.learner.train_many(
+                                self.state, k)
+                        else:
+                            self.state, m = self.learner.train_step(
+                                self.state)
+                        if self.obs.enabled:
+                            # honest host timing under async dispatch;
+                            # only paid when observability is on
+                            m = jax.block_until_ready(m)
             self._grad_steps_total += k
             self.grad_steps.add(k)
             self.obs.set_learner_step(self._grad_steps_total)
@@ -1015,6 +1062,17 @@ class ApexDriver:
                 if "td_abs_mean" in m:
                     self.obs.observe("td_abs", float(m["td_abs_mean"]))
                 self.obs.gauge("replay_occupancy", replay_size)
+                # perf-regression engine: feed the rolling throughput
+                # windows their local baselines (warn-only; peer-scoped
+                # baselines arrive via the fleet telemetry frames)
+                self.obs.perf_rate("grad_steps_per_s",
+                                   self.grad_steps.rate(),
+                                   step=self._grad_steps_total)
+                self.obs.perf_rate("env_fps", self.frames.rate(),
+                                   step=self._grad_steps_total)
+                self.obs.perf_rate("ingest_rows_per_s",
+                                   self.ingest_rows.rate(),
+                                   step=self._grad_steps_total)
                 self.obs.publish(self._grad_steps_total)
         # NOTE: a capture still open here (short run ending inside the
         # profile window) is closed by _learner_loop's finally

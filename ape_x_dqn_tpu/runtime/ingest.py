@@ -80,6 +80,14 @@ class IngestStager:
         # — obs surfaces it as ingest_decode_ms per put
         self.decode_ms = 0.0
         self.last_put_decode_ms = 0.0
+        # ship-side accounting: host wall-ms spent inside the ship
+        # callback (device_put enqueue + the donated add dispatch under
+        # _state_lock — NOT device execution time; the sampled roofline
+        # windows in the driver measure that). A last_ship_ms creeping
+        # toward the decode budget means the "async" transfer path has
+        # started blocking, i.e. the overlap is lost
+        self.ship_ms = 0.0
+        self.last_ship_ms = 0.0
         # cross-process correlation: tags (e.g. (peer, batch_id) from
         # the wire header) of batches staged since the last ship; the
         # ship callback reads `shipping_tags` to attribute the device
@@ -136,8 +144,11 @@ class IngestStager:
         buf = self._bufs[self._active]
         self.shipping_tags = tuple(self._pending_tags)
         self._pending_tags = []
+        t0 = time.perf_counter()
         self._inflight[self._active] = list(
             self._ship({k: buf[k] for k in self._keys}, self.coalesce))
+        self.last_ship_ms = (time.perf_counter() - t0) * 1e3
+        self.ship_ms += self.last_ship_ms
         self._active = (self._active + 1) % self.nb
         self._cursor = 0
 
@@ -158,10 +169,13 @@ class IngestStager:
         self.shipping_tags = tuple(self._pending_tags)
         self._pending_tags = []
         handles: list = []
+        t0 = time.perf_counter()
         for b in range(nblocks):
             views = {k: buf[k][b * self.block:(b + 1) * self.block]
                      for k in self._keys}
             handles += list(self._ship(views, 1))
+        self.last_ship_ms = (time.perf_counter() - t0) * 1e3
+        self.ship_ms += self.last_ship_ms
         rem = self._cursor - shipped
         if rem:
             # the shipped region becomes the compaction destination:

@@ -718,10 +718,21 @@ class MultihostApexDriver:
                     done = self._grad_steps
                     k = chunk_steps if chunk_steps <= \
                         max_grad_steps - done else 1
-                    with self.obs.span("learner.train", k=k):
-                        self.state, m = self.learner.train_many(self.state,
-                                                                k)
-                        loss = float(m["loss"])  # blocks: honest timing
+                    # roofline attribution: AOT lower/compile of the
+                    # exact train_many signature captures cost_analysis
+                    # roofs and pre-populates the jit cache (lockstep-
+                    # safe — compilation is deterministic across hosts)
+                    if not self.obs.stage_attached("train"):
+                        self.obs.stage_attach(
+                            "train", k,
+                            compile_fn=lambda: type(self.learner)
+                            .train_many.lower(self.learner, self.state,
+                                              k).compile())
+                    with self.obs.stage_window("train", k):
+                        with self.obs.span("learner.train", k=k):
+                            self.state, m = self.learner.train_many(
+                                self.state, k)
+                            loss = float(m["loss"])  # blocks: honest timing
                     self._grad_steps += k
                     self.obs.set_learner_step(self._grad_steps)
                     self.obs.mark("replay.sample",

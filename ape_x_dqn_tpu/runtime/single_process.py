@@ -12,6 +12,7 @@ learner and replay, swapping the in-process env loop for actor processes.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import jax
@@ -133,16 +134,31 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
         against jax's async dispatch. Priority write-back and target
         sync are fused inside the learn jit, so they ride as marks."""
         nonlocal state
-        with obs_.span("replay.sample", k=k):
-            sample, rng2 = learner.sample_k(state, k)
-            jax.block_until_ready(sample)
+        # roofline attribution (obs/profiling.py): AOT lower/compile of
+        # the exact dispatch signature captures cost_analysis FLOP/byte
+        # roofs AND populates the jit call cache, so the timed call
+        # below compiles nothing extra. First observed macro-step only.
+        if not obs_.stage_attached("sample_k"):
+            obs_.stage_attach(
+                "sample_k", k, compile_fn=lambda: type(learner).sample_k
+                .lower(learner, state, k).compile())
+        with obs_.stage_window("sample_k", k):
+            with obs_.span("replay.sample", k=k):
+                sample, rng2 = learner.sample_k(state, k)
+                jax.block_until_ready(sample)
         if age_tracker is not None:
             obs_.observe_sample_ages(
                 age_tracker.ages(np.asarray(sample[1]), grad_steps))
-        with obs_.span("learner.learn", k=k):
-            state, m = learner.learn_k(state._replace(rng=rng2),
-                                       sample, k)
-            m = jax.block_until_ready(m)
+        if not obs_.stage_attached("learn_k"):
+            obs_.stage_attach(
+                "learn_k", k, compile_fn=lambda: type(learner).learn_k
+                .lower(learner, state._replace(rng=rng2), sample, k)
+                .compile())
+        with obs_.stage_window("learn_k", k):
+            with obs_.span("learner.learn", k=k):
+                state, m = learner.learn_k(state._replace(rng=rng2),
+                                           sample, k)
+                m = jax.block_until_ready(m)
         obs_.mark("replay.priority_update", fused_into="learner.learn")
         sync = cfg.learner.target_sync_every
         if grad_steps // sync != (grad_steps + k) // sync:
@@ -154,6 +170,10 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
 
     pub_every = max(getattr(getattr(cfg, "obs", None),
                             "publish_every_steps", 500) or 500, 1)
+    # publish-boundary rate window for the perf-regression engine
+    rate_t = time.monotonic()
+    rate_frames = 0
+    rate_steps = 0
     while frames < total:
         obs_.beat("actor-0", f"frame {frames}")
         eps = max(eps_final, 1.0 - (1.0 - eps_final) * frames
@@ -232,6 +252,17 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
                         grad_steps // pub_every:
                     obs_.gauge("replay_occupancy",
                                int(state.replay.size))
+                    now = time.monotonic()
+                    if now > rate_t:
+                        dt = now - rate_t
+                        obs_.perf_rate("grad_steps_per_s",
+                                       (grad_steps - rate_steps) / dt,
+                                       step=grad_steps)
+                        obs_.perf_rate("env_fps",
+                                       (frames - rate_frames) / dt,
+                                       step=grad_steps)
+                    rate_t, rate_frames, rate_steps = \
+                        now, frames, grad_steps
                     obs_.publish(grad_steps)
         obs_.check_stalled()
         if (solve_return is not None and len(returns) >= 20

@@ -15,6 +15,12 @@ BASELINE.md); the driver-set target is >=2.0x.
 
 Secondary numbers (samples/s, inference forwards/s, compile/ingest times)
 go to stderr so the stdout contract stays parseable.
+
+The same line is persisted as the artifact of record (BENCH_LATEST.json,
+or BENCH_SMOKE.json under --smoke) so the perf trajectory is machine-
+readable, and --perf-gate turns it into a CI gate: the run exits nonzero
+when the headline value falls below --gate-frac of the newest comparable
+artifact.
 """
 
 from __future__ import annotations
@@ -46,6 +52,101 @@ def spread(runs) -> dict:
     return {"median": r(np.median(runs)),
             "min": r(np.min(runs)),
             "max": r(np.max(runs))}
+
+
+def _artifact_path(smoke: bool) -> str:
+    """Artifact of record for this bench shape. Smoke runs (shrunken CI
+    shapes) get their own file so a full-shape baseline is never
+    compared against a smoke run or vice versa."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here,
+                        "BENCH_SMOKE.json" if smoke else "BENCH_LATEST.json")
+
+
+def _load_baseline(smoke: bool) -> tuple[str | None, dict | None]:
+    """Newest comparable bench artifact: the (path, summary) of the
+    most recent BENCH_*.json whose content parses to a summary with
+    metric/value. Handles both the raw single-line summary this script
+    writes and the driver's capture format ({"parsed": <summary|null>,
+    ...}) — a null `parsed` (the pre-ISSUE-8 trajectory) is skipped."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    if smoke:
+        cands = [os.path.join(here, "BENCH_SMOKE.json")]
+    else:
+        cands = [p for p in glob.glob(os.path.join(here, "BENCH_*.json"))
+                 if os.path.basename(p) != "BENCH_SMOKE.json"]
+    cands = sorted((p for p in cands if os.path.exists(p)),
+                   key=os.path.getmtime, reverse=True)
+    for path in cands:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict) and "parsed" in doc:
+            doc = doc["parsed"]
+        if isinstance(doc, dict) and "metric" in doc and "value" in doc:
+            return path, doc
+    return None, None
+
+
+def _emit(result: dict, args) -> None:
+    """The stdout contract AND the artifact of record: print the final
+    single-line JSON summary, persist it next to this file (so driver
+    BENCH_*.json captures and the perf-gate both get non-null,
+    machine-readable data), then — under --perf-gate — exit nonzero if
+    this run regressed below --gate-frac of the last artifact."""
+    line = json.dumps(result)
+    gated = getattr(args, "perf_gate", False)
+    rc = _gate_exit(result, args) if gated else 0
+    # a gate-FAILING run must not become the next baseline: persisting
+    # it would ratchet the bar down so an equally-slow rerun passes
+    if rc == 0:
+        path = _artifact_path(getattr(args, "smoke", False))
+        try:
+            with open(path, "w") as fh:
+                fh.write(line + "\n")
+        except OSError as e:
+            log(f"could not write bench artifact {path}: {e!r}")
+    else:
+        log("perf-gate: artifact of record NOT updated by this "
+            "failing run")
+    print(line, flush=True)
+    if gated:
+        raise SystemExit(rc)
+
+
+def _gate_exit(result: dict, args) -> int:
+    """Warn-only elsewhere, a hard gate here: the whole point of
+    --perf-gate is a CI-visible nonzero exit on a real regression."""
+    base_path, base = getattr(args, "_baseline", (None, None))
+    if base is None:
+        log("perf-gate: no comparable BENCH_*.json baseline — pass "
+            "(this run's artifact seeds the trajectory)")
+        return 0
+    if base.get("metric") != result.get("metric"):
+        log(f"perf-gate: baseline metric {base.get('metric')!r} != "
+            f"{result.get('metric')!r} — not comparable, pass")
+        return 0
+    try:
+        value = float(result["value"])
+        baseline = float(base["value"])
+    except (KeyError, TypeError, ValueError):
+        log("perf-gate: non-numeric value(s) — not comparable, pass")
+        return 0
+    if baseline <= 0.0:
+        log(f"perf-gate: degenerate baseline {baseline} — pass")
+        return 0
+    ratio = value / baseline
+    if ratio < args.gate_frac:
+        log(f"perf-gate FAIL: {result['metric']} {value:.4g} is "
+            f"{ratio:.2f}x of baseline {baseline:.4g} "
+            f"({base_path}) — below --gate-frac {args.gate_frac}")
+        return 1
+    log(f"perf-gate pass: {result['metric']} {value:.4g} is "
+        f"{ratio:.2f}x of baseline {baseline:.4g} ({base_path})")
+    return 0
 
 
 def build_learner(capacity: int, batch_size: int, storage: str,
@@ -204,7 +305,11 @@ def bench_add_device(learner, state, spec, storage: str,
 
 def bench_learner(learner, state, steps_per_dispatch: int,
                   dispatches: int, repeats: int = 3,
-                  trace_dir: str | None = None):
+                  trace_dir: str | None = None,
+                  throttle_ms: float = 0.0):
+    """throttle_ms injects a host-side sleep per timed dispatch — the
+    perf-gate's test hook (an artificially slowed run must exit
+    nonzero under --perf-gate); 0 is the real measurement."""
     # compile + warmup dispatch (excluded from timing AND the trace —
     # a 20-40s compile window would drown the steady-state capture)
     t0 = time.monotonic()
@@ -220,6 +325,8 @@ def bench_learner(learner, state, steps_per_dispatch: int,
         try:
             for _ in range(dispatches):
                 state, m = learner.train_many(state, steps_per_dispatch)
+                if throttle_ms > 0.0:
+                    time.sleep(throttle_ms / 1e3)
             jax.block_until_ready(m["loss"])
         finally:
             if trace_dir and r == 0:
@@ -1266,36 +1373,73 @@ def main() -> None:
     p.add_argument("--peak-tflops", type=float, default=197.0,
                    help="chip peak bf16 TFLOP/s for the MFU estimate "
                    "(v5e-class default)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI-sized shapes (tiny capacity/batch, 1 "
+                   "repeat, no actor bench): seconds, not minutes, on "
+                   "a CPU host. Writes BENCH_SMOKE.json so smoke runs "
+                   "are only ever gated against smoke runs")
+    p.add_argument("--perf-gate", action="store_true",
+                   help="after the bench, compare this run's headline "
+                   "value against the newest comparable BENCH_*.json "
+                   "artifact and exit nonzero when it falls below "
+                   "--gate-frac of the baseline (the CI perf gate; "
+                   "no baseline = pass-and-seed)")
+    p.add_argument("--gate-frac", type=float, default=0.7,
+                   help="perf-gate threshold: fail when value < "
+                   "gate_frac * baseline (default 0.7 — generous "
+                   "enough for shared-host noise, tight enough to "
+                   "catch a real dispatch-path regression)")
+    p.add_argument("--throttle-ms", type=float, default=0.0,
+                   help="inject a host sleep (ms) per timed learner "
+                   "dispatch — the perf-gate's test hook for an "
+                   "artificially slowed run")
     args = p.parse_args()
+    if args.smoke:
+        args.capacity = min(args.capacity, 1 << 12)
+        args.batch_size = min(args.batch_size, 32)
+        args.prefill = min(args.prefill, 1 << 10)
+        args.steps_per_dispatch = min(args.steps_per_dispatch, 8)
+        args.dispatches = min(args.dispatches, 2)
+        args.repeats = 1
+        args.actor_frames = 0
+        # the A/B lanes (live soak rides the default lane) share these
+        args.ab_capacity = min(args.ab_capacity, 1 << 12)
+        args.ab_batch_size = min(args.ab_batch_size, 16)
+        args.ab_steps_per_dispatch = min(args.ab_steps_per_dispatch, 4)
+        args.ab_dispatches = min(args.ab_dispatches, 2)
+        args.chaos_ab_seconds = min(args.chaos_ab_seconds, 2.0)
+    # the baseline must be read BEFORE _emit overwrites the artifact
+    args._baseline = (_load_baseline(args.smoke) if args.perf_gate
+                      else (None, None))
 
     log(f"devices: {jax.devices()}")
     if args.prefetch_ab:
         ab = bench_prefetch_ab(args)
         gsps = ab["flat"]["off_first"]["off"]["median"]
-        print(json.dumps({
+        _emit({
             "metric": "learner_grad_steps_per_s",
             "value": round(gsps, 2),
             "unit": "steps/s",
             "vs_baseline": round(gsps / 19.0, 2),
             "secondary": {"prefetch_ab": ab},
-        }), flush=True)
+        }, args)
         return
     if args.ingest_ab:
         ab = bench_ingest_ab(args)
         gsps = ab["old_first"]["old"]["offline"]["median"]
-        print(json.dumps({
+        _emit({
             "metric": "learner_grad_steps_per_s",
             "value": round(gsps, 2),
             "unit": "steps/s",
             "vs_baseline": round(gsps / 19.0, 2),
             "secondary": {"ingest_ab": ab,
                           "live_gap": ab["live_gap_new"]},
-        }), flush=True)
+        }, args)
         return
     if args.telemetry_ab:
         ab = bench_telemetry_ab(args)
         worst = max(ab["overhead_pct"])
-        print(json.dumps({
+        _emit({
             "metric": "telemetry_overhead_pct",
             "value": worst,
             "unit": "%",
@@ -1303,28 +1447,28 @@ def main() -> None:
                 ab["on_first"]["on_items_per_s"]["median"]
                 / ab["on_first"]["off_items_per_s"]["median"], 3),
             "secondary": {"telemetry_ab": ab},
-        }), flush=True)
+        }, args)
         return
     if args.wire_ab:
         ab = bench_wire_ab(args)
-        print(json.dumps({
+        _emit({
             "metric": "wire_bytes_per_transition",
             "value": ab["raw_first"]["delta-deflate"][
                 "bytes_per_transition"],
             "unit": "bytes",
             "vs_baseline": ab["raw_first"]["delta-deflate"]["ratio"],
             "secondary": {"wire_ab": ab},
-        }), flush=True)
+        }, args)
         return
     if args.chaos_ab:
         ab = bench_chaos_ab(args)
-        print(json.dumps({
+        _emit({
             "metric": "chaos_availability",
             "value": ab["availability"],
             "unit": "ratio",
             "vs_baseline": ab["availability"],
             "secondary": {"chaos_ab": ab},
-        }), flush=True)
+        }, args)
         return
     h2d_rates = bench_h2d(repeats=args.repeats)
     log(f"h2d link: {spread(h2d_rates)} MB/s (pure device_put, 64MB "
@@ -1337,7 +1481,8 @@ def main() -> None:
 
     rates, state = bench_learner(learner, state, args.steps_per_dispatch,
                                  args.dispatches, repeats=args.repeats,
-                                 trace_dir=args.profile)
+                                 trace_dir=args.profile,
+                                 throttle_ms=args.throttle_ms)
     gsps = float(np.median(rates))
     log(f"learner: {spread(rates)} grad-steps/s @ batch "
         f"{args.batch_size} = {gsps * args.batch_size:,.0f} samples/s "
@@ -1398,13 +1543,13 @@ def main() -> None:
         secondary["apexlint"] = {"error": repr(e)}
 
     baseline = 19.0  # Horgan et al. 2018: 1-GPU learner, batch 512
-    print(json.dumps({
+    _emit({
         "metric": "learner_grad_steps_per_s",
         "value": round(gsps, 2),
         "unit": "steps/s",
         "vs_baseline": round(gsps / baseline, 2),
         "secondary": secondary,
-    }), flush=True)
+    }, args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,11 @@
+"""obs-names fixture: the two ways a perf-plane PR drifts.
+
+`mfu_learn_k` is emitted as a counter while the table lists a gauge
+(the report would look under ctr/ and never print it); `mfu_scratch`
+has no row at all (the report silently drops a new signal).
+"""
+
+
+def publish_stage(obs, mfu):
+    obs.count("mfu_learn_k", mfu)  # kind mismatch: table says gauge
+    obs.gauge("mfu_scratch", mfu)  # no INSTRUMENTS row, no waiver

@@ -1,0 +1,29 @@
+"""obs-names fixture: the continuous-perf-plane emission shape.
+
+Mirrors obs/profiling.py's literal if/elif gauge sites: every stage
+gauge and compile/perf counter carries a row in the profiling report
+fixture, each with the kind the registry publishes it under.
+"""
+
+
+def publish_stage(obs, stage, mfu, bw_frac, dev_ms):
+    if stage == "sample_k":
+        obs.gauge("mfu_sample_k", mfu)
+        obs.gauge("hbm_bw_frac_sample_k", bw_frac)
+        obs.gauge("device_ms_sample_k", dev_ms)
+    elif stage == "learn_k":
+        obs.gauge("mfu_learn_k", mfu)
+    elif stage == "ingest":
+        obs.gauge("hbm_bw_frac_ingest", bw_frac)
+        obs.gauge("device_ms_ingest", dev_ms)
+
+
+def publish_compile(obs, dn, ds, entries):
+    if dn > 0:
+        obs.count("jit_compiles", dn)
+        obs.count("jit_compile_ms", ds * 1e3)
+    obs.gauge("compile_cache_entries", entries)
+
+
+def fire_degradation(obs):
+    obs.count("perf_degradations")

@@ -1,0 +1,19 @@
+"""obs-names fixture: mini INSTRUMENTS table for the perf plane.
+
+Rows match profiling_good.py's emissions; `mfu_learn_k` is listed as a
+gauge so profiling_bad.py's counter emission is a kind-mismatch
+finding.
+"""
+
+INSTRUMENTS = {
+    "mfu_sample_k": {"kind": "gauge"},
+    "hbm_bw_frac_sample_k": {"kind": "gauge"},
+    "device_ms_sample_k": {"kind": "gauge"},
+    "mfu_learn_k": {"kind": "gauge"},
+    "hbm_bw_frac_ingest": {"kind": "gauge"},
+    "device_ms_ingest": {"kind": "gauge"},
+    "jit_compiles": {"kind": "ctr"},
+    "jit_compile_ms": {"kind": "ctr"},
+    "compile_cache_entries": {"kind": "gauge"},
+    "perf_degradations": {"kind": "ctr"},
+}

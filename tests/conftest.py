@@ -36,6 +36,16 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# compile-telemetry hook (obs/profiling.py): when run_chunked.sh
+# exports APEX_COMPILE_LOG, each pytest process appends one JSON line
+# {argv, jit_compiles, jit_compile_ms} at exit — the per-file
+# compile-cache growth record that turns the chunking workaround's
+# SIGSEGV regime into a monitored quantity
+if os.environ.get("APEX_COMPILE_LOG"):
+    from ape_x_dqn_tpu.obs.profiling import install_compile_log
+
+    install_compile_log(os.environ["APEX_COMPILE_LOG"])
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():

@@ -21,6 +21,13 @@ cd "$(dirname "$0")/.."
 fail=0
 failed_files=()
 
+# Compile-telemetry ledger (obs/profiling.py): each pytest process
+# appends one JSON line {argv, jit_compiles, jit_compile_ms} at exit,
+# making the per-file compile-cache growth this chunking exists to
+# bound a printed, monitored quantity instead of folklore.
+compile_log="$(mktemp "${TMPDIR:-/tmp}/apex_compile_log.XXXXXX")"
+export APEX_COMPILE_LOG="${compile_log}"
+
 # Static-analysis gate first: cheap (stdlib-only, no jax import) and a
 # finding here usually explains the test failure that would follow.
 echo "=== tools/apexlint"
@@ -31,12 +38,27 @@ fi
 echo
 for f in tests/test_*.py; do
     echo "=== ${f}"
+    lines_before=$(wc -l < "${compile_log}" 2>/dev/null || echo 0)
     if ! env JAX_PLATFORMS=cpu python -m pytest "${f}" -q -m 'not slow' \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"; then
         fail=1
         failed_files+=("${f}")
     fi
+    # crash-safe: only lines this file's process appended (a SIGSEGV
+    # before atexit simply prints nothing here)
+    tail -n +"$((lines_before + 1))" "${compile_log}" 2>/dev/null \
+        | sed 's/^/    compile growth: /'
 done
+
+# Perf-regression gate: the smoke bench compares against the last
+# committed BENCH_SMOKE.json artifact and exits nonzero on a >30%
+# throughput drop — warn-only gauges above, a hard gate here.
+echo
+echo "=== bench.py --perf-gate --smoke"
+if ! python bench.py --perf-gate --smoke; then
+    fail=1
+    failed_files+=("bench.py --perf-gate --smoke")
+fi
 
 echo
 if [ "${fail}" -ne 0 ]; then

@@ -178,6 +178,24 @@ def test_obs_names_fixtures():
     assert len(bad.findings) == 2
 
 
+def test_obs_names_profiling_fixtures():
+    """The perf-plane fixture pair (ISSUE 8): the good emitter's
+    literal if/elif stage gauges + compile counters cross-reference
+    cleanly; the bad emitter drifts both ways (kind mismatch on an
+    existing row, a brand-new gauge with no row)."""
+    report = _fx("profiling_report_fixture.py")
+    good = obs_names.check([_fx("profiling_good.py")], report)
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = obs_names.check(
+        [_fx("profiling_good.py"), _fx("profiling_bad.py")], report)
+    msgs = [f.message for f in bad.findings]
+    assert any("mfu_learn_k" in m for m in msgs)  # gauge-vs-ctr drift
+    assert any("mfu_scratch" in m for m in msgs)  # unlisted emission
+    assert len(bad.findings) == 2
+
+
 def test_obs_names_kind_mismatch(tmp_path):
     emit = tmp_path / "emit.py"
     emit.write_text("def f(obs):\n    obs.gauge('x_name', 1)\n")
