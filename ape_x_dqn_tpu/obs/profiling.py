@@ -202,8 +202,12 @@ def install_compile_log(path: str) -> None:
 
 # the observed stage vocabulary; every member has literal gauge
 # emission sites in _publish_stage below (the obs-names checker
-# cross-references string literals only)
-STAGES = ("sample_k", "learn_k", "train", "ingest")
+# cross-references string literals only). "train_dist" is the dist
+# learner's fused train_many dispatch (parallel/dist_learner.py): the
+# same roofline math against the same chip peaks, under its own gauge
+# names so a mesh run's per-dp attribution never aliases single-chip
+# "train" history (ISSUE 9 multichip lane)
+STAGES = ("sample_k", "learn_k", "train", "train_dist", "ingest")
 
 
 class StageProfiler:
@@ -303,10 +307,38 @@ def _publish_stage(obs, stage: str, mfu: float, bw_frac: float,
         obs.gauge("mfu_train", mfu)
         obs.gauge("hbm_bw_frac_train", bw_frac)
         obs.gauge("device_ms_train", dev_ms)
+    elif stage == "train_dist":
+        obs.gauge("mfu_train_dist", mfu)
+        obs.gauge("hbm_bw_frac_train_dist", bw_frac)
+        obs.gauge("device_ms_train_dist", dev_ms)
     elif stage == "ingest":
         # staging/ship is a pure-bandwidth stage: no MFU roof
         obs.gauge("hbm_bw_frac_ingest", bw_frac)
         obs.gauge("device_ms_ingest", dev_ms)
+
+
+def publish_multichip(obs, efficiency: float | None = None,
+                      fill_min: float | None = None,
+                      fill_max: float | None = None) -> None:
+    """Literal gauge emissions for the dp-scaling plane (ISSUE 9):
+
+    - dp_scaling_efficiency: grad-steps/s at dp normalized by dp x the
+      dp=1 rate — 1.0 is linear scaling. Published by the multichip
+      bench lane (bench.py --multichip), which is the only place the
+      dp=1 baseline exists; live driver runs carry the fill gauges.
+    - replay_shard_fill_min / _max: bounds of per-shard replay
+      occupancy fractions. Lockstep ingest keeps these equal; a gap
+      means shards are filling unevenly and the stratified sampler is
+      over-sampling (and down-weighting) the starved shards.
+
+    None skips a gauge — callers publish what they actually measured.
+    """
+    if efficiency is not None:
+        obs.gauge("dp_scaling_efficiency", efficiency)
+    if fill_min is not None:
+        obs.gauge("replay_shard_fill_min", fill_min)
+    if fill_max is not None:
+        obs.gauge("replay_shard_fill_max", fill_max)
 
 
 # -- perf-regression engine ------------------------------------------------

@@ -88,6 +88,24 @@ INSTRUMENTS = {
     "mfu_train": {"kind": "gauge"},
     "hbm_bw_frac_train": {"kind": "gauge"},
     "device_ms_train": {"kind": "gauge"},
+    # dist learner's fused dispatch (ISSUE 9): same roofline math,
+    # own names so mesh runs never alias single-chip train history
+    "mfu_train_dist": {"kind": "gauge"},
+    "hbm_bw_frac_train_dist": {"kind": "gauge"},
+    "device_ms_train_dist": {"kind": "gauge"},
+    # dp-scaling plane (bench.py --multichip + dist driver runs):
+    # "value_min" warn rows flag values BELOW the bound (efficiency
+    # and fill are healthy when high, unlike every gauge above)
+    "dp_scaling_efficiency": {
+        "kind": "gauge",
+        "warn": ("value_min", 0.5,
+                 "scaling efficiency below ~0.5 means over half of "
+                 "each added chip is lost to collectives/dispatch "
+                 "overhead — on shared-host virtual devices that is "
+                 "expected contention, on real chips it is a regression "
+                 "(PERF.md 'Multi-chip scaling')")},
+    "replay_shard_fill_min": {"kind": "gauge"},
+    "replay_shard_fill_max": {"kind": "gauge"},
     "hbm_bw_frac_ingest": {"kind": "gauge"},
     "device_ms_ingest": {"kind": "gauge"},
     "ingest_ship_ms": {"kind": "gauge"},
@@ -172,6 +190,21 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         elif len(parts) == 4:
             peers.setdefault(parts[1], {}).setdefault(
                 parts[2], {})[parts[3]] = v
+    # multichip scaling lane: `multichip/dp<N>/<stat>` keys the bench
+    # lane (bench.py --multichip) appends to the JSONL — one group per
+    # dp point, same raw-key pattern as the fleet peer frames
+    multichip: dict[int, dict[str, Any]] = {}
+    for k, v in latest.items():
+        if not k.startswith("multichip/dp"):
+            continue
+        parts = k.split("/", 2)
+        if len(parts) != 3:
+            continue
+        try:
+            dp = int(parts[1][2:])
+        except ValueError:
+            continue
+        multichip.setdefault(dp, {})[parts[2]] = v
     spans = {k[len("span/"):]: v for k, v in latest.items()
              if k.startswith("span/") and isinstance(v, dict)}
     hists = {k[len("hist/"):]: v for k, v in latest.items()
@@ -202,6 +235,8 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "ctrs": ctrs,
         "hbm": hbm,
         "peers": peers,
+        "multichip": multichip,
+        "virtual_devices": latest.get("virtual_devices"),
         "disconnects": disconnects,
         "stalls": stalls,
         "perf_events": perf_events,
@@ -318,12 +353,17 @@ def _fmt_slo(summary: dict[str, Any]) -> list[str]:
             f"over n={int(lat['count'])} requests "
             f"(healthy p99 < {HEALTHY['infer_latency_ms'][1]})")
     for name, v in gauge_rows:
-        _, bound, why = HEALTHY[name]
-        flag = float(v) > bound
+        kind, bound, why = HEALTHY[name]
+        # "value_min" rows (e.g. dp_scaling_efficiency) are healthy
+        # when HIGH: flag below the bound instead of above it
+        low_side = kind == "value_min"
+        flag = float(v) < bound if low_side else float(v) > bound
+        rel = "≥" if low_side else "≤"
         lines.append(f"  {name:<22} {_n(v)} "
-                     f"(healthy ≤ {_n(float(bound))})")
+                     f"(healthy {rel} {_n(float(bound))})")
         if flag:
-            lines.append(f"    ⚠ value={_n(v)} exceeds healthy "
+            verb = "falls below" if low_side else "exceeds"
+            lines.append(f"    ⚠ value={_n(v)} {verb} healthy "
                          f"~{bound}: {why}")
     return lines
 
@@ -339,6 +379,8 @@ _ROOFLINE_STAGES = (
      "device_ms_learn_k", "learner.learn"),
     ("train", "mfu_train", "hbm_bw_frac_train",
      "device_ms_train", "learner.train"),
+    ("train_dist", "mfu_train_dist", "hbm_bw_frac_train_dist",
+     "device_ms_train_dist", "learner.train"),
     ("ingest", None, "hbm_bw_frac_ingest",
      "device_ms_ingest", "replay.add"),
 )
@@ -388,6 +430,46 @@ def _fmt_roofline(summary: dict[str, Any]) -> list[str]:
             f"  compile telemetry: {_n(n)} backend compiles, "
             f"{float(ms):.0f} ms total, process cache entries="
             f"{_n(entries)}")
+    return lines
+
+
+def _fmt_multichip(summary: dict[str, Any]) -> list[str]:
+    """dp-scaling curve from the multichip bench lane (bench.py
+    --multichip): one row per dp point with throughput, efficiency vs
+    dp=1, per-shard fill bounds, and the dist-dispatch roofline gauges.
+    Efficiency on virtual devices (one shared host) is a correctness/
+    overhead signal, not a speedup claim — see PERF.md."""
+    points = summary.get("multichip", {})
+    if not points:
+        return []
+    virt = summary.get("virtual_devices")
+    tag = ("virtual devices — shared host, efficiency is an overhead "
+           "signal" if virt else "real chips")
+    lines = [f"multichip scaling ({tag}):",
+             f"  {'dp':>4} {'grad_steps/s':>13} {'efficiency':>11} "
+             f"{'shard_fill':>13} {'mfu':>8} {'dev_ms':>9} "
+             f"{'ingest_rows/s':>14}"]
+    for dp in sorted(points):
+        p = points[dp]
+        eff = p.get("efficiency")
+        fmin, fmax = p.get("shard_fill_min"), p.get("shard_fill_max")
+        fill = (f"{float(fmin):.2f}..{float(fmax):.2f}"
+                if fmin is not None and fmax is not None else "-")
+        mfu = p.get("mfu_train_dist")
+        ms = p.get("device_ms_train_dist")
+        lines.append(
+            f"  {dp:>4} {_n(p.get('grad_steps_per_s')):>13} "
+            f"{(f'{float(eff):.2f}x' if eff is not None else '-'):>11} "
+            f"{fill:>13} "
+            f"{(f'{float(mfu):.2%}' if mfu else '-'):>8} "
+            f"{(f'{float(ms):.2f}' if ms is not None else '-'):>9} "
+            f"{_n(p.get('ingest_rows_per_s')):>14}")
+        if eff is not None and float(eff) < HEALTHY[
+                "dp_scaling_efficiency"][1] and dp > 1:
+            lines.append(f"    ⚠ dp={dp} efficiency {float(eff):.2f} "
+                         f"below healthy ~"
+                         f"{HEALTHY['dp_scaling_efficiency'][1]}: "
+                         f"{HEALTHY['dp_scaling_efficiency'][2]}")
     return lines
 
 
@@ -484,6 +566,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if roofline_lines:
         lines.append("")
         lines.extend(roofline_lines)
+    multichip_lines = _fmt_multichip(summary)
+    if multichip_lines:
+        lines.append("")
+        lines.extend(multichip_lines)
     if summary["hists"]:
         lines.append("")
         lines.append("staleness / distribution percentiles:")

@@ -444,6 +444,43 @@ class _DistLearnerBase:
                     lambda _: self._repl_sharding, state.params))
         return self._reshard(state.params)
 
+    # -- per-shard observability -------------------------------------------
+
+    def shard_stats(self, state: DistTrainState) -> dict:
+        """Per-shard replay fill/sample statistics for the obs plane
+        and the multichip bench lane (bench.py --multichip):
+
+        - sizes: ring occupancy per shard in the replay's native item
+          units (transitions for flat/frame-ring, sequences for R2D2);
+        - live: live item count per shard (frame-ring layouts exclude
+          dead episode-pad slots via `live_transitions`; other layouts
+          report sizes);
+        - fill: sizes / per-shard capacity;
+        - tree_mass: per-shard sum-tree root — the stratified-sampling
+          denominator. Skew here is IS-weight skew (down-weighted by
+          the global-N recipe in _sample_weighted), not an error.
+
+        Host-side device fetch; call off the hot loop (teardown,
+        publish boundaries, bench epilogues)."""
+        rs = state.replay
+        sizes = np.asarray(rs.size).reshape(-1).astype(np.int64)
+        live = sizes
+        if hasattr(self.replay, "live_transitions"):
+            live = np.asarray(self.replay.live_transitions(rs)
+                              ).reshape(-1).astype(np.int64)
+        cap = float(max(int(self.replay.capacity), 1))
+        # tree layout is [dp, 2*cap] with the root mass at index 1
+        mass = np.asarray(rs.tree[:, 1]).astype(np.float64)
+        fill = sizes / cap
+        return {
+            "sizes": sizes.tolist(),
+            "live": live.tolist(),
+            "fill": [round(float(f), 6) for f in fill],
+            "tree_mass": [round(float(m), 4) for m in mass],
+            "fill_min": float(fill.min()),
+            "fill_max": float(fill.max()),
+        }
+
 
 class DistDQNLearner(_DistLearnerBase):
     """Flat n-step double-DQN over the mesh (SURVEY.md §3.3)."""

@@ -345,3 +345,12 @@ class FrameRingReplay(PrioritizedReplay):
     def valid_mask(self, state: ReplayState, idx: jax.Array) -> jax.Array:
         """[B] f32: 1 on live transitions, 0 on dead pad slots."""
         return (state.storage["next_off"][idx] > 0).astype(jnp.float32)
+
+    def live_transitions(self, state: ReplayState) -> jax.Array:
+        """Count of live (non-pad) transition slots, reducing only the
+        trailing slot axis — so it works unchanged on a single-chip
+        state (scalar out) and on the dp-sharded lockstep state
+        ([dp] out), where it feeds the per-shard fill stats of the
+        multichip lane (bench.py --multichip) and
+        `_DistLearnerBase.shard_stats`."""
+        return (state.storage["next_off"] > 0).sum(axis=-1)

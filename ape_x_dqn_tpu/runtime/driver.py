@@ -258,6 +258,16 @@ class ApexDriver:
         self._stage_chunk = setup.stage_chunk
         self._unit_items = setup.unit_items
         self._stage_dropped = 0
+        # the same staged drops attributed per dp shard: unit i of a
+        # would-be [dp, chunk] block lands on shard i // stage_chunk
+        # (the round-robin split _ship_staged reshapes into), so the
+        # closure sum(_stage_dropped_per_shard) == _stage_dropped holds
+        # in every denomination (pinned by tests/test_ingest.py)
+        self._stage_dropped_per_shard = np.zeros(self.dp, np.int64)
+        # roofline stage vocabulary: the dist learner's fused dispatch
+        # attributes under its own stage so a mesh run's gauges are
+        # distinguishable from single-chip "train" (obs/profiling.py)
+        self._train_stage = "train_dist" if self.is_dist else "train"
         self._item_spec = item_spec
         # zero-copy pipelined staging (runtime/ingest.py): wire batches
         # decode directly into preallocated [coalesce*block] buffers,
@@ -793,14 +803,22 @@ class ApexDriver:
             tail = self._stager.tail_units()
             if force and tail:
                 if self._frame_mode:
-                    self._stage_dropped += int(
-                        (self._stager.tail_view("next_off") > 0).sum())
+                    # live transitions per staged unit, then folded to
+                    # shards — segments carry dead episode-tail pads
+                    live = (self._stager.tail_view("next_off") > 0
+                            ).sum(axis=-1)
+                    per_shard = self._tail_shard_counts(live)
                 elif self.family == "r2d2":
-                    self._stage_dropped += tail * self.cfg.replay.seq_length
+                    per_shard = np.asarray(
+                        self._stager.tail_shard_units(self.dp),
+                        np.int64) * self.cfg.replay.seq_length
                 else:
-                    self._stage_dropped += tail
+                    per_shard = np.asarray(
+                        self._stager.tail_shard_units(self.dp), np.int64)
                     with self._lock:
                         self._frames_total -= tail
+                self._stage_dropped += int(per_shard.sum())
+                self._stage_dropped_per_shard += per_shard
                 self._stager.discard_tail()
             return
         block = self.dp * self._stage_chunk
@@ -829,25 +847,41 @@ class ApexDriver:
                 # tail pads), and leave _frames_total alone: env-frame
                 # counts ride ingest messages separately in frame mode
                 # and those frames were genuinely consumed
-                self._stage_dropped += int(sum(
-                    (np.asarray(b["next_off"]) > 0).sum()
-                    for b in self._stage))
+                live = np.concatenate(
+                    [(np.asarray(b["next_off"]) > 0).sum(axis=-1)
+                     for b in self._stage])
+                per_shard = self._tail_shard_counts(live)
             elif self.family == "r2d2":
                 # units are sequences; env frames also ride ingest
                 # messages separately here, so _frames_total stays.
                 # The drop stat is transition-denominated: seq_length
                 # per sequence (an upper bound — overlapping
                 # sequences double-count their shared steps)
-                self._stage_dropped += (self._stage_n
-                                        * self.cfg.replay.seq_length)
+                per_shard = self._tail_shard_counts(np.full(
+                    self._stage_n, self.cfg.replay.seq_length, np.int64))
             else:
                 # flat mode: 1 unit = 1 env frame, keep the frames
                 # counter reconciled with what actually reached replay
-                self._stage_dropped += self._stage_n
+                per_shard = self._tail_shard_counts(
+                    np.ones(self._stage_n, np.int64))
                 with self._lock:
                     self._frames_total -= self._stage_n
+            self._stage_dropped += int(per_shard.sum())
+            self._stage_dropped_per_shard += per_shard
             self._stage = []
             self._stage_n = 0
+
+    def _tail_shard_counts(self, per_unit) -> np.ndarray:
+        """Fold unit-indexed drop counts into per-shard totals: staged
+        unit i of a (would-be) [dp, stage_chunk] block belongs to shard
+        i // stage_chunk — the same C-order round-robin reshape
+        _ship_staged puts on the mesh. The tail is always shorter than
+        one block (whole blocks ship before any drop), so the index
+        never overflows dp."""
+        out = np.zeros(self.dp, np.int64)
+        for i, n in enumerate(np.asarray(per_unit, np.int64)):
+            out[i // self._stage_chunk] += int(n)
+        return out
 
     def _warmup(self) -> None:
         """AOT-compile the hot jits before any thread starts.
@@ -897,9 +931,10 @@ class ApexDriver:
             c_many = cls.train_many.lower(learner, self.state,
                                           chunk).compile()
             self.obs.log_compiled("train_many", c_many)
-            self.obs.stage_attach("train", chunk, compiled=c_many)
+            self.obs.stage_attach(self._train_stage, chunk,
+                                  compiled=c_many)
         else:
-            self.obs.stage_attach("train", 1, compiled=c_step)
+            self.obs.stage_attach(self._train_stage, 1, compiled=c_step)
         # roofline attribution (obs/profiling.py): the warmed executables
         # already carry cost_analysis — attach them so the learner-loop
         # stage windows and the sampled ingest windows can turn wall time
@@ -1005,7 +1040,7 @@ class ApexDriver:
                 # the stage window rides the span's existing
                 # block_until_ready sync point — no extra sync is added
                 # for the roofline gauges on the fused train path
-                with self.obs.stage_window("train", k):
+                with self.obs.stage_window(self._train_stage, k):
                     with self.obs.span("learner.train", k=k):
                         if k > 1:
                             self.state, m = self.learner.train_many(
@@ -1062,6 +1097,17 @@ class ApexDriver:
                 if "td_abs_mean" in m:
                     self.obs.observe("td_abs", float(m["td_abs_mean"]))
                 self.obs.gauge("replay_occupancy", replay_size)
+                if self.is_dist:
+                    # lockstep ingest fills every shard equally, so the
+                    # live bounds come from the host fill mirror (no
+                    # device fetch on the hot loop); any future
+                    # non-lockstep ingest shows up as divergence in the
+                    # bench lane's true per-shard stats (shard_stats)
+                    from ape_x_dqn_tpu.obs.profiling import (
+                        publish_multichip)
+                    fill = replay_size / max(self.capacity, 1)
+                    publish_multichip(self.obs, fill_min=fill,
+                                      fill_max=fill)
                 # perf-regression engine: feed the rolling throughput
                 # windows their local baselines (warn-only; peer-scoped
                 # baselines arrive via the fleet telemetry frames)
@@ -1300,7 +1346,7 @@ class ApexDriver:
         with self._lock:
             avg_ret = (float(np.mean(self.episode_returns))
                        if self.episode_returns else 0.0)
-        return {
+        out = {
             "frames": self._frames_total,
             "grad_steps": self._grad_steps_total,
             "avg_return": avg_ret,
@@ -1308,6 +1354,10 @@ class ApexDriver:
             "wall_s": time.monotonic() - t0,
             "server": self.server.stats,
             "ingest_dropped": self.transport.dropped + self._stage_dropped,
+            # staged-drop attribution only: transport-queue drops happen
+            # before the [dp, chunk] round-robin split exists
+            "ingest_dropped_per_shard":
+                self._stage_dropped_per_shard.tolist(),
             "actor_errors": list(self.actor_errors),
             "actor_restarts": list(self.actor_restarts),
             "actor_quarantines": sorted(self._quarantined),
@@ -1315,3 +1365,11 @@ class ApexDriver:
             "loop_errors": list(self.loop_errors),
             "eval": self.last_eval,
         }
+        if self.is_dist:
+            # teardown-time per-shard fill/mass: the state is quiescent
+            # (all loops joined above), so the device fetch is safe
+            try:
+                out["replay_shards"] = self.learner.shard_stats(self.state)
+            except Exception:  # noqa: BLE001 - teardown stats are
+                pass           # best-effort; never fail a finished run
+        return out

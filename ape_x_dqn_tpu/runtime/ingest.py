@@ -199,6 +199,18 @@ class IngestStager:
         drop accounting counts live transitions via next_off)."""
         return self._bufs[self._active][key][:self._cursor]
 
+    def tail_shard_units(self, dp: int) -> list[int]:
+        """Unit count of the current sub-block tail per dp shard under
+        the driver's round-robin block split: a shipped block reshapes
+        [block] -> [dp, chunk] (chunk = block // dp) in C order, so
+        tail unit i would have landed on shard i // chunk. The driver's
+        per-shard drop closure (`sum(per_shard) == dropped`, pinned by
+        tests/test_ingest.py) folds these counts into whichever
+        denomination the storage family drops in."""
+        chunk = self.block // max(dp, 1)
+        tail = self.tail_units()
+        return [max(0, min(tail - d * chunk, chunk)) for d in range(dp)]
+
     def discard_tail(self) -> None:
         self._cursor = 0
 

@@ -1275,6 +1275,393 @@ def bench_inference(net, spec, batch: int = 64, iters: int = 50,
     return rates
 
 
+# -- multichip scaling lane (ISSUE 9) --------------------------------------
+
+_MULTICHIP_ROUND = "r02"
+_MULTICHIP_MARKER = "MULTICHIP_CHILD "
+
+
+def _multichip_artifact_path(smoke: bool) -> str:
+    """Artifact of record for the dp-scaling lane. Same smoke/full split
+    as the main bench: a full-shape curve is never gated against a CI
+    smoke curve."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    name = ("MULTICHIP_SMOKE.json" if smoke
+            else f"MULTICHIP_{_MULTICHIP_ROUND}.json")
+    return os.path.join(here, name)
+
+
+def _multichip_jsonl_path(smoke: bool) -> str:
+    """Obs-format metrics JSONL the lane writes alongside the artifact —
+    the file `python -m ape_x_dqn_tpu.obs.report` renders the multichip
+    section from (per-dp multichip/dp<N>/* records + the summary
+    gauges)."""
+    return _multichip_artifact_path(smoke).replace(".json", ".jsonl")
+
+
+def _load_multichip_baseline(smoke: bool, virtual: bool,
+                             dp_list: list[int]
+                             ) -> tuple[str | None, dict | None]:
+    """Newest COMPARABLE multichip artifact: same smoke class, same
+    virtual-vs-real device mode, same dp set. Scaling efficiency on 8
+    virtual devices sharing one host says nothing about 8 real chips
+    (and vice versa), and a dp=1,2 smoke curve says nothing about the
+    full 1/2/4/8 sweep — cross-shape comparisons would gate on noise.
+    Pre-curve artifacts (e.g. MULTICHIP_r01.json, a raw dryrun capture
+    with no metric/value) are skipped the same way _load_baseline skips
+    null driver captures."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    if smoke:
+        cands = [os.path.join(here, "MULTICHIP_SMOKE.json")]
+    else:
+        cands = [p for p in glob.glob(os.path.join(here,
+                                                   "MULTICHIP_*.json"))
+                 if os.path.basename(p) != "MULTICHIP_SMOKE.json"]
+    cands = sorted((p for p in cands if os.path.exists(p)),
+                   key=os.path.getmtime, reverse=True)
+    for path in cands:
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not (isinstance(doc, dict) and "metric" in doc
+                and "value" in doc):
+            continue
+        if bool(doc.get("virtual_devices")) != bool(virtual):
+            log(f"multichip gate: {os.path.basename(path)} is a "
+                f"{'virtual' if doc.get('virtual_devices') else 'real'}"
+                f"-device curve — not comparable, skipped")
+            continue
+        if sorted(doc.get("dp") or []) != sorted(dp_list):
+            log(f"multichip gate: {os.path.basename(path)} covers "
+                f"dp={doc.get('dp')} != {dp_list} — not comparable, "
+                f"skipped")
+            continue
+        return path, doc
+    return None, None
+
+
+class _GaugeSink:
+    """Minimal obs stand-in for StageProfiler/publish_multichip in the
+    bench child: collects the literal gauge emissions into a dict."""
+
+    def __init__(self):
+        self.gauges: dict[str, float] = {}
+
+    def gauge(self, name: str, value) -> None:
+        self.gauges[name] = float(value)
+
+
+def _dist_seg_chunk(replay, spec, dp: int, g: int, rng):
+    """[dp, g]-stacked frame-ring segments for the lockstep add path
+    (items {"seg_frames": [dp, g, F, H, W], fields [dp, g, B]} —
+    dist_learner.add -> FrameRingReplay.add_lockstep)."""
+    b, f = replay.B, replay.F
+    items = {
+        "seg_frames": jnp.asarray(
+            rng.integers(0, 255, (dp, g, f, *spec.obs_shape[:2])),
+            jnp.uint8),
+        "action": jnp.asarray(
+            rng.integers(0, spec.num_actions, (dp, g, b)), jnp.int32),
+        "reward": jnp.asarray(rng.normal(size=(dp, g, b)), jnp.float32),
+        "discount": jnp.full((dp, g, b), 0.99**3, jnp.float32),
+        "next_off": jnp.full((dp, g, b), 3, jnp.int32),
+    }
+    return items, jnp.asarray(rng.uniform(0.1, 2.0, (dp, g, b)),
+                              jnp.float32)
+
+
+def bench_multichip_child(args) -> None:
+    """One dp point of the scaling sweep, run in a FRESH process (the
+    parent provisions JAX_PLATFORMS/XLA_FLAGS before this interpreter
+    imports jax — the only way to get N virtual host devices, since
+    the flag is read once at backend init).
+
+    Builds the dp-sharded frame-ring stack the dist driver runs
+    (FrameRingReplay at per-shard capacity under DistDQNLearner on a
+    (dp, 1) mesh), prefills via timed lockstep add dispatches, times
+    the fused train_many, and attributes it through StageProfiler's
+    "train_dist" stage — the same roofline math the live driver
+    publishes. Emits ONE marker-prefixed JSON line on stdout."""
+    from ape_x_dqn_tpu.configs import LearnerConfig, NetworkConfig
+    from ape_x_dqn_tpu.envs.base import EnvSpec
+    from ape_x_dqn_tpu.models import build_network
+    from ape_x_dqn_tpu.obs.profiling import StageProfiler
+    from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
+    from ape_x_dqn_tpu.parallel.mesh import make_mesh
+    from ape_x_dqn_tpu.replay.frame_ring import FrameRingReplay
+    from ape_x_dqn_tpu.utils.rng import component_key
+
+    dp = int(args.multichip_child)
+    devices = jax.devices()
+    log(f"multichip child dp={dp}: {len(devices)} "
+        f"{devices[0].platform} devices")
+    mesh = make_mesh(dp=dp, tp=1)
+    spec = EnvSpec(obs_shape=(84, 84, 4), obs_dtype=np.dtype(np.uint8),
+                   discrete=True, num_actions=18)
+    seg = 16
+    # equal-total-capacity split: per-shard capacity shrinks with dp
+    # (the whole point of sharding), floored to a legal segment multiple
+    cap_shard = max((args.capacity // dp) // seg, 4) * seg
+    replay = FrameRingReplay(capacity=cap_shard, seg_transitions=seg,
+                             n_step=3, obs_shape=spec.obs_shape)
+    net = build_network(NetworkConfig(kind="nature_cnn", dueling=True),
+                        spec)
+    params = net.init(component_key(0, "net_init"),
+                      jnp.zeros((1, 84, 84, 4), jnp.uint8))
+    lcfg = LearnerConfig(batch_size=args.batch_size,
+                         sample_chunk=args.sample_chunk)
+    learner = DistDQNLearner(net.apply, replay, lcfg, mesh)
+    state = learner.init(params, None, component_key(0, "learner"))
+
+    # -- timed lockstep ingest (equal [dp, g] blocks, like the driver's
+    # round-robin split ships them) -----------------------------------
+    rng = np.random.default_rng(0)
+    segs_per_shard = max(args.prefill // (dp * seg), 1)
+    g = min(segs_per_shard, 8)
+    items, pris = _dist_seg_chunk(replay, spec, dp, g, rng)
+    state = learner.add(state, items, pris)  # compile
+    jax.block_until_ready(state.replay.tree)
+    n_dispatch = max(segs_per_shard // g, 1)
+    t0 = time.monotonic()
+    for _ in range(n_dispatch):
+        state = learner.add(state, items, pris)
+    jax.block_until_ready(state.replay.tree)
+    rows_per_s = n_dispatch * dp * g * seg / (time.monotonic() - t0)
+    log(f"lockstep ingest: {rows_per_s:,.0f} rows/s "
+        f"({n_dispatch} dispatches of [dp={dp}, g={g}] blocks)")
+
+    # -- fused train_many, attributed as "train_dist" ------------------
+    sink = _GaugeSink()
+    profiler = StageProfiler(sink)
+    steps = args.steps_per_dispatch
+    try:
+        compiled = type(learner).train_many.lower(learner, state,
+                                                  steps).compile()
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        log(f"multichip child: AOT cost analysis unavailable: {e!r}")
+        compiled = None
+    profiler.attach("train_dist", steps, compiled=compiled)
+    t0 = time.monotonic()
+    state, m = learner.train_many(state, steps)
+    jax.block_until_ready(m["loss"])
+    log(f"train_many compile+first dispatch: "
+        f"{time.monotonic() - t0:.1f}s (loss={float(m['loss']):.4f})")
+    rates = []
+    for _ in range(args.repeats):
+        t0 = time.monotonic()
+        for _ in range(args.dispatches):
+            with profiler.window("train_dist", steps):
+                state, m = learner.train_many(state, steps)
+                jax.block_until_ready(m["loss"])
+        rates.append(steps * args.dispatches / (time.monotonic() - t0))
+    assert np.isfinite(float(m["loss"])), "non-finite loss at dp=%d" % dp
+    result = {
+        "dp": dp,
+        "grad_steps_per_s": spread(rates),
+        "ingest_rows_per_s": float(f"{rows_per_s:.4g}"),
+        "gauges": sink.gauges,
+        "shards": learner.shard_stats(state),
+        "cap_shard": cap_shard,
+        "batch_size": args.batch_size,
+        "n_devices": len(devices),
+        "platform": devices[0].platform,
+    }
+    print(_MULTICHIP_MARKER + json.dumps(result), flush=True)
+
+
+def bench_multichip(args) -> None:
+    """The dp-scaling sweep (tentpole (b)): one child process per dp
+    point, each self-provisioned with a CONSTANT device count (virtual
+    host devices when no real accelerator fleet is visible), so every
+    point sees the same backend topology and the efficiency curve
+    isolates sharding/collective overhead from device-count skew.
+
+    Writes the curve artifact (MULTICHIP_<round>.json, smoke runs to
+    MULTICHIP_SMOKE.json) plus an obs-format metrics JSONL that
+    `python -m ape_x_dqn_tpu.obs.report` renders as the multichip
+    section. Under --perf-gate the headline (scaling efficiency at the
+    largest dp) gates against the newest comparable artifact — same
+    virtual/real mode, same dp set, same smoke class — with the same
+    anti-ratchet rule as the main bench (a failing run never becomes
+    the next baseline)."""
+    import subprocess
+
+    spec_str = args.multichip.strip()
+    if spec_str.startswith("dp="):
+        spec_str = spec_str[3:]
+    try:
+        dp_list = sorted({int(d) for d in spec_str.split(",") if d})
+    except ValueError:
+        raise SystemExit(
+            f"bad --multichip dp list: {args.multichip!r}") from None
+    if not dp_list or dp_list[0] < 1:
+        raise SystemExit(f"bad --multichip dp list: {args.multichip!r}")
+    bad = [d for d in dp_list if args.batch_size % d]
+    if bad:
+        raise SystemExit(f"--batch-size {args.batch_size} must divide "
+                         f"by every dp point (violates: {bad})")
+    n_dev = max(dp_list)
+    devices = jax.devices()
+    real = [d for d in devices if d.platform != "cpu"]
+    virtual = len(real) < n_dev
+    env = os.environ.copy()
+    if virtual:
+        # the forcing flag is read ONCE at backend init — hence child
+        # processes, and the parent strips any stale copy of the flag
+        # so its own appended value wins
+        xf = " ".join(
+            t for t in env.get("XLA_FLAGS", "").split()
+            if not t.startswith(
+                "--xla_force_host_platform_device_count"))
+        env["XLA_FLAGS"] = (
+            f"{xf} --xla_force_host_platform_device_count={n_dev}"
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        log(f"multichip: {n_dev} VIRTUAL host devices (one shared "
+            f"host — efficiency is an overhead signal, not a speedup "
+            f"claim; PERF.md 'Multi-chip scaling')")
+    else:
+        log(f"multichip: {len(real)} real {real[0].platform} devices")
+    curve: dict[str, dict] = {}
+    ok = True
+    for dp in dp_list:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--multichip-child", str(dp),
+               "--capacity", str(args.capacity),
+               "--batch-size", str(args.batch_size),
+               "--prefill", str(args.prefill),
+               "--steps-per-dispatch", str(args.steps_per_dispatch),
+               "--dispatches", str(args.dispatches),
+               "--repeats", str(args.repeats),
+               "--sample-chunk", str(args.sample_chunk)]
+        if args.smoke:
+            cmd.append("--smoke")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(cmd, env=env, capture_output=True,
+                                  text=True, timeout=1800)
+        except subprocess.TimeoutExpired:
+            log(f"multichip dp={dp}: child TIMED OUT")
+            ok = False
+            continue
+        point = None
+        for line in proc.stdout.splitlines():
+            if line.startswith(_MULTICHIP_MARKER):
+                try:
+                    point = json.loads(line[len(_MULTICHIP_MARKER):])
+                except json.JSONDecodeError:
+                    point = None
+        if proc.returncode != 0 or point is None:
+            tail = (proc.stderr or proc.stdout or "").strip()
+            log(f"multichip dp={dp}: child FAILED rc={proc.returncode}"
+                f"\n{tail[-2000:]}")
+            ok = False
+            continue
+        point["wall_s"] = round(time.monotonic() - t0, 1)
+        curve[str(dp)] = point
+        log(f"multichip dp={dp}: "
+            f"{point['grad_steps_per_s']['median']} grad-steps/s, "
+            f"shard fill {point['shards']['fill_min']:.3f}.."
+            f"{point['shards']['fill_max']:.3f} ({point['wall_s']}s)")
+    # scaling efficiency vs dp=1: rate_dp / (dp * rate_dp1). 1.0 is
+    # linear scaling; virtual devices contend for one host, so < 1 is
+    # expected there and the number reads as overhead, not speedup
+    base = curve.get("1", {}).get("grad_steps_per_s", {}).get("median")
+    for dp in dp_list:
+        pt = curve.get(str(dp))
+        if pt is None:
+            continue
+        rate = pt["grad_steps_per_s"]["median"]
+        pt["efficiency"] = (round(rate / (dp * base), 4)
+                            if base else None)
+    eff_points = [curve[str(d)]["efficiency"] for d in dp_list
+                  if str(d) in curve
+                  and curve[str(d)].get("efficiency") is not None]
+    headline = eff_points[-1] if eff_points else 0.0
+    ok = ok and len(curve) == len(dp_list) and bool(eff_points)
+
+    jsonl_path = _multichip_jsonl_path(args.smoke)
+    try:
+        with open(jsonl_path, "w") as fh:
+            for i, dp in enumerate(dp_list):
+                pt = curve.get(str(dp))
+                if pt is None:
+                    continue
+                rec = {"step": i,
+                       f"multichip/dp{dp}/grad_steps_per_s":
+                           pt["grad_steps_per_s"]["median"],
+                       f"multichip/dp{dp}/efficiency":
+                           pt.get("efficiency"),
+                       f"multichip/dp{dp}/shard_fill_min":
+                           pt["shards"]["fill_min"],
+                       f"multichip/dp{dp}/shard_fill_max":
+                           pt["shards"]["fill_max"],
+                       f"multichip/dp{dp}/ingest_rows_per_s":
+                           pt["ingest_rows_per_s"]}
+                for k in ("mfu_train_dist", "device_ms_train_dist",
+                          "hbm_bw_frac_train_dist"):
+                    if k in pt["gauges"]:
+                        rec[f"multichip/dp{dp}/{k}"] = pt["gauges"][k]
+                fh.write(json.dumps(rec) + "\n")
+            # summary record: last-write-wins gauges for the SLO table
+            # (largest completed dp point) + the virtual-device stamp
+            last = curve.get(str(dp_list[-1])) or {}
+            summary_rec = {"step": len(dp_list),
+                           "virtual_devices": virtual,
+                           "gauge/dp_scaling_efficiency": headline}
+            if last:
+                summary_rec["gauge/replay_shard_fill_min"] = \
+                    last["shards"]["fill_min"]
+                summary_rec["gauge/replay_shard_fill_max"] = \
+                    last["shards"]["fill_max"]
+                for k, v in last["gauges"].items():
+                    summary_rec[f"gauge/{k}"] = v
+            fh.write(json.dumps(summary_rec) + "\n")
+        log(f"multichip metrics JSONL -> {jsonl_path} (render with "
+            f"`python -m ape_x_dqn_tpu.obs.report {jsonl_path}`)")
+    except OSError as e:
+        log(f"could not write multichip metrics JSONL: {e!r}")
+
+    result = {
+        "metric": "multichip_dp_scaling_efficiency",
+        "value": headline,
+        "unit": "ratio",
+        "ok": ok,
+        "virtual_devices": virtual,
+        "dp": dp_list,
+        "n_devices": n_dev,
+        "smoke": bool(args.smoke),
+        "curve": curve,
+        "metrics_jsonl": os.path.basename(jsonl_path),
+    }
+    line = json.dumps(result)
+    gated = getattr(args, "perf_gate", False)
+    rc = 0
+    if gated:
+        args._baseline = _load_multichip_baseline(args.smoke, virtual,
+                                                  dp_list)
+        rc = _gate_exit(result, args)
+    if not ok:
+        log("multichip: sweep incomplete — artifact NOT updated")
+        rc = rc or 1
+    if rc == 0 or not gated:
+        if ok:
+            path = _multichip_artifact_path(args.smoke)
+            try:
+                with open(path, "w") as fh:
+                    fh.write(line + "\n")
+            except OSError as e:
+                log(f"could not write multichip artifact {path}: {e!r}")
+    else:
+        log("multichip perf-gate: artifact of record NOT updated by "
+            "this failing run")
+    print(line, flush=True)
+    raise SystemExit(rc)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--capacity", type=int, default=1 << 20,
@@ -1363,6 +1750,20 @@ def main() -> None:
                    help="timed window per chaos-ab arm; the fault "
                    "schedule (garble phase, cut, restart outage) is "
                    "proportional to it")
+    p.add_argument("--multichip", default=None, metavar="dp=1,2,4,8",
+                   help="run the dp-scaling sweep INSTEAD of the main "
+                   "bench: one fresh child process per dp point, each "
+                   "self-provisioned with a constant device count "
+                   "(XLA_FLAGS=--xla_force_host_platform_device_count "
+                   "virtual host devices when no real accelerator "
+                   "fleet is visible), building the dp-sharded "
+                   "frame-ring stack (DistDQNLearner) and timing "
+                   "lockstep ingest + fused train_many. Writes "
+                   "MULTICHIP_<round>.json + an obs-format metrics "
+                   "JSONL for obs/report.py (PERF.md 'Multi-chip "
+                   "scaling'). Accepts '1,2,4,8' or 'dp=1,2,4,8'")
+    p.add_argument("--multichip-child", type=int, default=None,
+                   metavar="DP", help=argparse.SUPPRESS)
     p.add_argument("--ab-batch-size", type=int, default=64,
                    help="batch size for the prefetch A/B arms (small "
                    "enough to iterate on a CPU host; raise on a real "
@@ -1412,6 +1813,14 @@ def main() -> None:
     args._baseline = (_load_baseline(args.smoke) if args.perf_gate
                       else (None, None))
 
+    if args.multichip_child is not None:
+        # one dp point of the sweep, running in the provisioned child
+        # interpreter (see bench_multichip)
+        bench_multichip_child(args)
+        return
+    if args.multichip:
+        bench_multichip(args)
+        return
     log(f"devices: {jax.devices()}")
     if args.prefetch_ab:
         ab = bench_prefetch_ab(args)

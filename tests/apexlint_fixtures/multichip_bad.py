@@ -1,0 +1,12 @@
+"""obs-names fixture: the two ways a multichip PR drifts.
+
+`dp_scaling_efficiency` is emitted as a counter while the table lists a
+gauge (the report's SLO row would look under ctr/ and never fire);
+`replay_shard_fill_median` has no row at all (a new per-shard signal
+the report would silently drop).
+"""
+
+
+def publish_multichip(obs, efficiency, fill_med):
+    obs.count("dp_scaling_efficiency", efficiency)  # kind mismatch
+    obs.gauge("replay_shard_fill_median", fill_med)  # no row, no waiver

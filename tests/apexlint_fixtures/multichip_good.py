@@ -1,0 +1,21 @@
+"""obs-names fixture: the dp-scaling plane's emission shape (ISSUE 9).
+
+Mirrors obs/profiling.py's publish_multichip + the train_dist branch of
+_publish_stage: every multichip gauge carries a row in the multichip
+report fixture under the kind the registry publishes it as.
+"""
+
+
+def publish_multichip(obs, efficiency, fill_min, fill_max):
+    if efficiency is not None:
+        obs.gauge("dp_scaling_efficiency", efficiency)
+    if fill_min is not None:
+        obs.gauge("replay_shard_fill_min", fill_min)
+    if fill_max is not None:
+        obs.gauge("replay_shard_fill_max", fill_max)
+
+
+def publish_train_dist(obs, mfu, bw_frac, dev_ms):
+    obs.gauge("mfu_train_dist", mfu)
+    obs.gauge("hbm_bw_frac_train_dist", bw_frac)
+    obs.gauge("device_ms_train_dist", dev_ms)

@@ -1,0 +1,15 @@
+"""obs-names fixture: mini INSTRUMENTS table for the dp-scaling plane.
+
+Rows match multichip_good.py's emissions; `dp_scaling_efficiency` is
+listed as a gauge so multichip_bad.py's counter emission is a
+kind-mismatch finding.
+"""
+
+INSTRUMENTS = {
+    "dp_scaling_efficiency": {"kind": "gauge"},
+    "replay_shard_fill_min": {"kind": "gauge"},
+    "replay_shard_fill_max": {"kind": "gauge"},
+    "mfu_train_dist": {"kind": "gauge"},
+    "hbm_bw_frac_train_dist": {"kind": "gauge"},
+    "device_ms_train_dist": {"kind": "gauge"},
+}

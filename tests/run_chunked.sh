@@ -60,6 +60,19 @@ if ! python bench.py --perf-gate --smoke; then
     failed_files+=("bench.py --perf-gate --smoke")
 fi
 
+# Multi-chip smoke: dp=1,2 over virtual devices (the lane
+# self-provisions --xla_force_host_platform_device_count in child
+# processes). Proves the sharded ingest/train path end-to-end and
+# anti-ratchets dp-scaling efficiency against the last comparable
+# (same dp set, same device mode) MULTICHIP_SMOKE.json — incomparable
+# baselines are skipped, never compared across shapes.
+echo
+echo "=== bench.py --multichip dp=1,2 --smoke"
+if ! python bench.py --multichip dp=1,2 --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --multichip dp=1,2 --smoke")
+fi
+
 echo
 if [ "${fail}" -ne 0 ]; then
     echo "FAILED files: ${failed_files[*]}"

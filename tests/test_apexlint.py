@@ -196,6 +196,24 @@ def test_obs_names_profiling_fixtures():
     assert len(bad.findings) == 2
 
 
+def test_obs_names_multichip_fixtures():
+    """The dp-scaling fixture pair (ISSUE 9): the good emitter's
+    publish_multichip + train_dist literal gauges cross-reference
+    cleanly against the mini table; the bad emitter drifts both ways
+    (efficiency emitted as a counter, an unlisted per-shard gauge)."""
+    report = _fx("multichip_report_fixture.py")
+    good = obs_names.check([_fx("multichip_good.py")], report)
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = obs_names.check(
+        [_fx("multichip_good.py"), _fx("multichip_bad.py")], report)
+    msgs = [f.message for f in bad.findings]
+    assert any("dp_scaling_efficiency" in m for m in msgs)
+    assert any("replay_shard_fill_median" in m for m in msgs)
+    assert len(bad.findings) == 2
+
+
 def test_obs_names_kind_mismatch(tmp_path):
     emit = tmp_path / "emit.py"
     emit.write_text("def f(obs):\n    obs.gauge('x_name', 1)\n")

@@ -1,0 +1,285 @@
+"""dp-sharded frame-ring replay (ISSUE 9 tentpole (a)).
+
+The dist driver has run frame-ring configs over the mesh since the
+flagship e2e test; these tests pin the SEMANTICS of that path:
+
+- dp=1 bitwise parity: the sharded state (leading [dp] axis, lockstep
+  adds, vmapped single-shard sampling/write-back) at dp=1 must be the
+  single-chip FrameRingReplay bit for bit — sharding is a layout
+  decision, never a numerics decision.
+- skewed-shard-fill IS weights: the global-N recipe from
+  tests/test_parallel.py::test_skewed_shard_is_weights, re-proven on
+  frame-ring storage where shard fills (not just priority masses) can
+  diverge and dead episode-pad slots must train with weight 0.
+- shard_stats: the per-shard fill/mass observability surface the
+  multichip lane (bench.py --multichip) and the run report consume.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ape_x_dqn_tpu.configs import LearnerConfig
+from ape_x_dqn_tpu.parallel.dist_learner import DistDQNLearner
+from ape_x_dqn_tpu.parallel.mesh import make_mesh
+from ape_x_dqn_tpu.replay.frame_ring import FrameRingReplay
+
+OBS_SHAPE = (6, 6, 4)
+
+
+def _ring(cap=64, seg=8, **kw):
+    return FrameRingReplay(capacity=cap, seg_transitions=seg, n_step=3,
+                           obs_shape=OBS_SHAPE, **kw)
+
+
+def _segs(replay, g, rng, next_off=3):
+    b, f = replay.B, replay.F
+    items = {
+        "seg_frames": jnp.asarray(
+            rng.integers(0, 255, (g, f, *OBS_SHAPE[:2])), jnp.uint8),
+        "action": jnp.asarray(rng.integers(0, 4, (g, b)), jnp.int32),
+        "reward": jnp.asarray(rng.normal(size=(g, b)), jnp.float32),
+        "discount": jnp.full((g, b), 0.97, jnp.float32),
+        "next_off": jnp.full((g, b), next_off, jnp.int32),
+    }
+    pris = jnp.asarray(rng.uniform(0.1, 2.0, (g, b)), jnp.float32)
+    return items, pris
+
+
+def _stack1(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def _assert_state_eq(single, sharded_dp1):
+    """Every sharded leaf is the single-chip leaf under a leading [1]."""
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)[0]), single, sharded_dp1)
+
+
+# -- dp=1 bitwise parity ---------------------------------------------------
+
+
+def test_dp1_lockstep_add_sample_update_parity():
+    """add_lockstep / vmapped sample_items / vmapped update_priorities
+    at dp=1 land the same bits as the single-chip ops under the same
+    seed — storage, sum-tree, indices, probs, gathered stacks, all of
+    it."""
+    replay = _ring()
+    rng = np.random.default_rng(0)
+    items, pris = _segs(replay, 4, rng)
+
+    s1 = replay.add(replay.init(), items, pris)
+    sd = replay.add_lockstep(_stack1(replay.init()), _stack1(items),
+                             pris[None])
+    _assert_state_eq(s1, sd)
+
+    # same key bits on both paths: split once, shard 0 IS the key
+    keys = jax.random.split(jax.random.key(42), 1)
+    it1, idx1, p1 = replay.sample_items(s1, keys[0], 16)
+    itd, idxd, pd = jax.vmap(
+        lambda rs, k: replay.sample_items(rs, k, 16))(sd, keys)
+    np.testing.assert_array_equal(np.asarray(idx1), np.asarray(idxd)[0])
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(pd)[0])
+    _assert_state_eq(it1, itd)
+
+    td = jnp.asarray(np.random.default_rng(3).uniform(0.1, 1.0, 16),
+                     jnp.float32)
+    u1 = replay.update_priorities(s1, idx1, td)
+    ud = jax.vmap(replay.update_priorities)(sd, idxd, td[None])
+    _assert_state_eq(u1, ud)
+
+
+def test_dp1_add_many_matches_single_chip_adds():
+    """The dist learner's coalesced add_many ([g, dp, ...] unrolled
+    lockstep chain) at dp=1 equals g sequential single-chip adds."""
+    replay = _ring()
+    mesh = make_mesh(dp=1, tp=1)
+    lcfg = LearnerConfig(batch_size=16)
+    learner = DistDQNLearner(lambda p, o: o, replay, lcfg, mesh)
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    state = learner.init(params, None, jax.random.key(0))
+
+    rng = np.random.default_rng(7)
+    blocks = [_segs(replay, 2, rng) for _ in range(3)]
+    grp_items = jax.tree.map(lambda *xs: jnp.stack(xs)[:, None],
+                             *[b[0] for b in blocks])
+    grp_td = jnp.stack([b[1] for b in blocks])[:, None]
+    state = learner.add_many(state, grp_items, grp_td)
+
+    s1 = replay.init()
+    for items, pris in blocks:
+        s1 = replay.add(s1, items, pris)
+    _assert_state_eq(s1, state.replay)
+
+
+# -- skewed shard fills ----------------------------------------------------
+
+
+def test_skewed_shard_fill_is_weights_frame_ring():
+    """Frame-ring twin of test_parallel.py::test_skewed_shard_is_weights,
+    with the skew in the FILL (shard 0 holds 2 segments, shard 1 is
+    full) as well as the priority mass (1000x starved). Constant
+    per-shard values + priorities make the beta=1 weighted estimate
+    zero-variance, so one vmapped draw must recover the exact uniform
+    mean over the GLOBAL live pool — the global-N recipe of
+    _sample_weighted."""
+    dp, cap, seg = 2, 64, 8
+    replay = _ring(cap=cap, seg=seg, alpha=1.0, beta=1.0, eps=0.0)
+    mesh = make_mesh(dp=dp, tp=1)
+    learner = DistDQNLearner(lambda p, o: o,
+                             replay, LearnerConfig(batch_size=64), mesh)
+
+    masses = [1e-3, 1.0]
+    n_segs = [2, cap // seg]
+    rng = np.random.default_rng(0)
+    states = []
+    for d in range(dp):
+        g = n_segs[d]
+        items, _ = _segs(replay, g, rng)
+        # shard value g_d = d+1 rides the action field
+        items["action"] = jnp.full((g, seg), d + 1, jnp.int32)
+        live = g * seg
+        pris = jnp.full((g, seg), masses[d] / live, jnp.float32)
+        states.append(replay.add(replay.init(), items, pris))
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+    keys = jax.random.split(jax.random.key(0), dp)
+    items, idx, w = learner._sample_weighted(state, keys, 32)
+    w = np.asarray(w, np.float64)
+    g_val = np.asarray(items["action"]).astype(np.float64)
+
+    # all drawn slots are live, so every weight is positive and exactly
+    # the valid_mask-gated formula weight
+    valid = np.asarray(jax.vmap(replay.valid_mask)(state, idx))
+    assert (valid == 1.0).all()
+    assert (w > 0.0).all() and np.isfinite(w).all()
+
+    n0, n1 = n_segs[0] * seg, n_segs[1] * seg
+    uniform_mean = (n0 * 1.0 + n1 * 2.0) / (n0 + n1)
+    est = float((w * g_val).mean())
+    assert abs(est - uniform_mean) < 1e-3, (est, uniform_mean)
+
+
+def test_dead_pad_slots_sample_with_zero_weight():
+    """A shard whose tail segment is all episode pads (next_off == 0)
+    keeps those slots out of training: any draw landing on one gets IS
+    weight exactly 0 via the vmapped valid_mask gate."""
+    dp, cap, seg = 2, 32, 8
+    replay = _ring(cap=cap, seg=seg, alpha=1.0, beta=1.0, eps=0.0)
+    mesh = make_mesh(dp=dp, tp=1)
+    learner = DistDQNLearner(lambda p, o: o,
+                             replay, LearnerConfig(batch_size=64), mesh)
+    rng = np.random.default_rng(1)
+    states = []
+    for d in range(dp):
+        items, pris = _segs(replay, 2, rng,
+                            next_off=3 if d == 0 else 0)
+        states.append(replay.add(replay.init(), items, pris))
+    state = jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+    keys = jax.random.split(jax.random.key(5), dp)
+    _, idx, w = learner._sample_weighted(state, keys, 32)
+    w = np.asarray(w)
+    valid = np.asarray(jax.vmap(replay.valid_mask)(state, idx))
+    # shard 1 is ALL pads: every one of its weights must be zeroed
+    assert (valid[1] == 0.0).all()
+    np.testing.assert_array_equal(w[1], np.zeros_like(w[1]))
+    assert (w[0] > 0.0).all()
+
+
+# -- per-shard observability -----------------------------------------------
+
+
+def test_shard_stats_reports_per_shard_fill_and_mass():
+    """shard_stats: sizes/live/fill/tree_mass per shard, with frame-ring
+    live counts excluding dead pads — the numbers the multichip lane
+    and the run report publish."""
+    dp, cap, seg = 2, 32, 8
+    replay = _ring(cap=cap, seg=seg)
+    mesh = make_mesh(dp=dp, tp=1)
+    learner = DistDQNLearner(lambda p, o: o,
+                             replay, LearnerConfig(batch_size=16), mesh)
+    state = learner.init({"w": jnp.zeros((2,), jnp.float32)}, None,
+                         jax.random.key(0))
+    rng = np.random.default_rng(2)
+    items, pris = _segs(replay, 2, rng)
+    # shard-varying liveness: shard 0 fully live, shard 1 half pads
+    no = np.broadcast_to(np.asarray(items["next_off"]),
+                         (dp, 2, seg)).copy()
+    no[1, :, seg // 2:] = 0
+    d_items = {k: jnp.broadcast_to(v, (dp,) + v.shape)
+               for k, v in items.items()}
+    d_items["next_off"] = jnp.asarray(no)
+    state = learner.add(state, d_items,
+                        jnp.broadcast_to(pris, (dp,) + pris.shape))
+    stats = learner.shard_stats(state)
+    assert stats["sizes"] == [16, 16]
+    assert stats["live"] == [16, 8]
+    assert stats["fill"] == [0.5, 0.5]
+    assert stats["fill_min"] == stats["fill_max"] == 0.5
+    assert len(stats["tree_mass"]) == dp
+    assert all(m > 0 for m in stats["tree_mass"])
+
+
+def test_live_transitions_single_and_sharded():
+    """live_transitions reduces only the slot axis: scalar on a
+    single-chip state, [dp] on the stacked lockstep state."""
+    replay = _ring(cap=32, seg=8)
+    rng = np.random.default_rng(4)
+    items, pris = _segs(replay, 2, rng)
+    s1 = replay.add(replay.init(), items, pris)
+    assert int(replay.live_transitions(s1)) == 16
+    sd = replay.add_lockstep(_stack1(replay.init()), _stack1(items),
+                             pris[None])
+    assert np.asarray(replay.live_transitions(sd)).tolist() == [16]
+
+
+def test_multichip_baseline_comparable_shapes_only(tmp_path, monkeypatch):
+    """The --multichip anti-ratchet gate only compares like with like:
+    same device mode, same dp set, real curve artifacts only. A
+    cross-mode or cross-shape artifact (or a pre-curve raw capture like
+    MULTICHIP_r01.json) is skipped, never compared."""
+    import importlib
+    import json as _json
+    import sys as _sys
+
+    repo_root = __file__.rsplit("/tests/", 1)[0]
+    if repo_root not in _sys.path:
+        _sys.path.insert(0, repo_root)
+    bench = importlib.import_module("bench")
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+
+    def _write(name, doc):
+        (tmp_path / name).write_text(_json.dumps(doc))
+
+    # pre-curve raw capture: no metric/value -> never a baseline
+    _write("MULTICHIP_r01.json", {"ok": False, "n_devices": 1})
+    # real-device curve: wrong mode for a virtual run
+    _write("MULTICHIP_r02.json",
+           {"metric": "multichip_dp_scaling_efficiency", "value": 0.9,
+            "virtual_devices": False, "dp": [1, 2, 4, 8]})
+    path, doc = bench._load_multichip_baseline(
+        smoke=False, virtual=True, dp_list=[1, 2, 4, 8])
+    assert path is None and doc is None
+
+    # comparable virtual curve, but a different dp set -> skipped
+    _write("MULTICHIP_r03.json",
+           {"metric": "multichip_dp_scaling_efficiency", "value": 0.5,
+            "virtual_devices": True, "dp": [1, 2]})
+    path, doc = bench._load_multichip_baseline(
+        smoke=False, virtual=True, dp_list=[1, 2, 4, 8])
+    assert path is None and doc is None
+
+    # the genuinely comparable artifact wins
+    _write("MULTICHIP_r04.json",
+           {"metric": "multichip_dp_scaling_efficiency", "value": 0.5,
+            "virtual_devices": True, "dp": [8, 4, 2, 1]})  # order-free
+    path, doc = bench._load_multichip_baseline(
+        smoke=False, virtual=True, dp_list=[1, 2, 4, 8])
+    assert path is not None and path.endswith("MULTICHIP_r04.json")
+    assert doc["value"] == 0.5
+
+    # smoke class never reads the full-shape artifacts
+    path, doc = bench._load_multichip_baseline(
+        smoke=True, virtual=True, dp_list=[1, 2, 4, 8])
+    assert path is None and doc is None

@@ -143,6 +143,88 @@ def test_r2d2_tail_drop_accounting(zero_copy):
     assert d._replay_filled == block * d._unit_items
 
 
+# -- per-shard drop closure under the [dp, chunk] round-robin split --------
+# (ISSUE 9 satellite 3): the same three denominations, attributed to
+# the shard each tail unit WOULD have landed on (unit i -> shard
+# i // stage_chunk), with sum(per_shard) == dropped exactly.
+
+
+def _dp2(cfg):
+    return cfg.replace(parallel=ParallelConfig(dp=2, tp=1))
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_flat_per_shard_drop_closure_dp2(zero_copy):
+    d = ApexDriver(_dp2(_flat_cfg(ingest_zero_copy=zero_copy)))
+    assert d.is_dist and d.dp == 2
+    chunk = d._stage_chunk
+    block = d.dp * chunk
+    tail = chunk + 2  # spans shard 0 fully + 2 units into shard 1
+    assert block > tail  # a tail is always shorter than one block
+    d._ingest_one(_synth_batch(d, block + tail), block + tail)
+    d._flush_stage(force=True)
+    assert d._stage_dropped == tail
+    assert d._stage_dropped_per_shard.tolist() == [chunk, 2]
+    assert int(d._stage_dropped_per_shard.sum()) == d._stage_dropped
+    assert d._frames_total == block
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_frame_ring_per_shard_drop_closure_dp2(zero_copy):
+    """Frame-ring denomination per shard: each dropped tail segment
+    contributes its LIVE transition count to the shard it was bound
+    for."""
+    d = ApexDriver(_dp2(_ring_cfg(ingest_zero_copy=zero_copy)))
+    assert d.is_dist and d._frame_mode
+    chunk = d._stage_chunk
+    block = d.dp * chunk
+    tail = chunk + 1
+    assert block > tail
+    batch = _synth_batch(d, block + tail, frames=11)
+    # tail unit j carries exactly j+1 live transitions
+    batch["next_off"][block:] = 0
+    for j in range(tail):
+        batch["next_off"][block + j, :j + 1] = 2
+    d._ingest_one(batch, block + tail)
+    d._flush_stage(force=True)
+    assert d._stage_dropped == sum(j + 1 for j in range(tail))
+    assert d._stage_dropped_per_shard.tolist() == [
+        sum(j + 1 for j in range(chunk)), chunk + 1]
+    assert int(d._stage_dropped_per_shard.sum()) == d._stage_dropped
+    assert d._frames_total == 11  # untouched by frame-mode drops
+
+
+@pytest.mark.parametrize("zero_copy", [False, True])
+def test_r2d2_per_shard_drop_closure_dp2(zero_copy):
+    d = ApexDriver(_dp2(_r2d2_cfg(ingest_zero_copy=zero_copy)))
+    assert d.is_dist and d.family == "r2d2"
+    chunk = d._stage_chunk
+    block = d.dp * chunk
+    tail = chunk + 1
+    assert block > tail
+    d._ingest_one(_synth_batch(d, block + tail, frames=29), block + tail)
+    d._flush_stage(force=True)
+    seq = d.cfg.replay.seq_length
+    assert d._stage_dropped == tail * seq
+    assert d._stage_dropped_per_shard.tolist() == [chunk * seq, seq]
+    assert int(d._stage_dropped_per_shard.sum()) == d._stage_dropped
+    assert d._frames_total == 29
+
+
+def test_stager_tail_shard_units_round_robin():
+    """IngestStager.tail_shard_units mirrors the [block] -> [dp, chunk]
+    C-order reshape: tail unit i belongs to shard i // chunk."""
+    st, _ = _unit_stager(block=8, coalesce=2)
+    st.put(_rows(8 + 5, 0))
+    assert st.drain() == 1  # ships the complete block, compacts 5
+    assert st.tail_units() == 5
+    assert st.tail_shard_units(2) == [4, 1]  # chunk = 4
+    assert st.tail_shard_units(4) == [2, 2, 1, 0]  # chunk = 2
+    assert st.tail_shard_units(1) == [5]
+    st.discard_tail()
+    assert st.tail_shard_units(2) == [0, 0]
+
+
 def test_drop_accounting_in_run_report():
     """_stage_dropped reaches the run report's ingest_dropped."""
     d = ApexDriver(_flat_cfg(ingest_zero_copy=True))

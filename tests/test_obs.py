@@ -263,6 +263,38 @@ def test_report_summarize_and_format():
     assert "component=actor-3" in text
 
 
+def test_report_multichip_section():
+    """The dp-scaling records the bench lane writes (`multichip/dpN/*`
+    keys + the top-level virtual_devices flag) regroup into a per-dp
+    curve and render as the multichip table, with the below-healthy
+    efficiency warn and the virtual-device framing."""
+    recs = [
+        {"step": 0, "multichip/dp1/grad_steps_per_s": 0.9,
+         "multichip/dp1/efficiency": 1.0,
+         "multichip/dp1/shard_fill_min": 1.0,
+         "multichip/dp1/shard_fill_max": 1.0,
+         "multichip/dp1/ingest_rows_per_s": 5000.0},
+        {"step": 1, "multichip/dp2/grad_steps_per_s": 0.7,
+         "multichip/dp2/efficiency": 0.39,
+         "multichip/dp2/shard_fill_min": 0.98,
+         "multichip/dp2/shard_fill_max": 1.0,
+         "multichip/dp2/mfu_train_dist": 0.012,
+         "multichip/dp2/device_ms_train_dist": 45.0,
+         "multichip/dp2/ingest_rows_per_s": 4000.0},
+        {"step": 2, "virtual_devices": True,
+         "gauge/dp_scaling_efficiency": 0.39},
+    ]
+    s = summarize(recs)
+    assert sorted(s["multichip"]) == [1, 2]
+    assert s["multichip"][2]["efficiency"] == 0.39
+    assert s["virtual_devices"] is True
+    text = format_report(s)
+    assert "multichip scaling" in text
+    assert "virtual devices" in text
+    assert "0.39x" in text
+    assert "below healthy" in text  # dp=2 efficiency warn fires
+
+
 def test_report_cli_subprocess(tmp_path):
     import os
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
