@@ -302,6 +302,19 @@ class ObsConfig:
     perf_ewma_alpha: float = 0.1
     perf_min_samples: int = 8
     perf_cooldown_s: float = 30.0
+    # -- learning-health plane (obs/learning.py, ISSUE 10) --------------
+    # warn-only anomaly engine over the in-graph learner diagnostics
+    # (loss spikes vs an EWMA baseline + absolute rules for Q blowup,
+    # ESS collapse, dead gradients, priority collapse — thresholds in
+    # obs/learning.py, mirrored by obs/report.py healthy ranges). The
+    # learn_* gauges themselves ride the learner's metrics pytree and
+    # are published whenever obs is enabled; this knob only gates the
+    # event engine.
+    learn_health: bool = True
+    learn_spike_mult: float = 10.0
+    learn_ewma_alpha: float = 0.2
+    learn_min_samples: int = 8
+    learn_cooldown_s: float = 30.0
     # MFU / bandwidth-fraction denominators; 0 = auto from
     # jax.devices()[0].device_kind (obs/profiling.device_peaks)
     device_peak_flops: float = 0.0

@@ -40,6 +40,9 @@ BATCH_EDGES = tuple(float(2 ** i) for i in range(12))
 # sub-ms when the server keeps up, deadline_ms-ish when batching, and
 # unbounded when the queue backs up — the serving-SLO instrument
 LATENCY_EDGES = geometric_edges(0.1, 1e4, per_decade=4)
+# per-dispatch training loss (learning-health plane, obs/learning.py):
+# wide geometric range because a loss spike IS the signal
+LOSS_EDGES = geometric_edges(1e-6, 1e3, per_decade=2)
 
 
 class NullObs:
@@ -51,6 +54,7 @@ class NullObs:
     watchdog = None
     profiler = None
     perf = None
+    learn = None
 
     def span(self, name: str, **args: Any):
         return NULL_TRACER.span(name)
@@ -68,6 +72,10 @@ class NullObs:
 
     def perf_rate(self, name: str, value, step: int = 0,
                   peer: str = "") -> None:
+        pass
+
+    def learn_health(self, diag, loss, step: int = 0,
+                     tenant: str = "") -> None:
         pass
 
     def mark(self, name: str, **args: Any) -> None:
@@ -183,6 +191,7 @@ class Obs:
         self.registry.histogram("td_abs", TD_EDGES)
         self.registry.histogram("server_batch_items", BATCH_EDGES)
         self.registry.histogram("infer_latency_ms", LATENCY_EDGES)
+        self.registry.histogram("learn_loss", LOSS_EDGES)
         self._learner_step = 0
         # jax.profiler window: False = armed, True = tracing,
         # None = done/disabled (single capture per run)
@@ -211,6 +220,17 @@ class Obs:
             min_samples=getattr(cfg, "perf_min_samples", 8),
             cooldown_s=getattr(cfg, "perf_cooldown_s", 30.0))
             if getattr(cfg, "perf_regression", True) else None)
+        # learning-health plane (obs/learning.py, ISSUE 10): warn-only
+        # anomaly engine over the in-graph learner diagnostics
+        from ape_x_dqn_tpu.obs import learning
+
+        self.learn = (learning.LearnMonitor(
+            self, metrics,
+            spike_mult=getattr(cfg, "learn_spike_mult", 10.0),
+            alpha=getattr(cfg, "learn_ewma_alpha", 0.2),
+            min_samples=getattr(cfg, "learn_min_samples", 8),
+            cooldown_s=getattr(cfg, "learn_cooldown_s", 30.0))
+            if getattr(cfg, "learn_health", True) else None)
 
     # -- tracing -----------------------------------------------------------
 
@@ -313,6 +333,24 @@ class Obs:
         engine (warn-only PerfDegradation events)."""
         if self.perf is not None:
             self.perf.observe(name, value, step=step, peer=peer)
+
+    # -- learning-health plane (obs/learning.py) ---------------------------
+
+    def learn_health(self, diag, loss, step: int = 0,
+                     tenant: str = "") -> None:
+        """Publish one host-read learner diagnostic snapshot (the
+        metrics['diag'] pytree) as `learn_*` gauges + the loss hist,
+        and feed the warn-only LearnMonitor. Callers must pass values
+        already synced by their existing block_until_ready — this
+        method only converts ready device scalars (no new syncs)."""
+        from ape_x_dqn_tpu.obs import learning
+
+        vals = {k: float(v) for k, v in dict(diag).items()}
+        learning.publish_learn(self, vals, tenant=tenant)
+        loss = float(loss)
+        self.observe("learn_loss", loss)
+        if self.learn is not None:
+            self.learn.observe(vals, loss, step=step, tenant=tenant)
 
     # -- jax integration ---------------------------------------------------
 

@@ -127,6 +127,53 @@ INSTRUMENTS = {
     "ewma_grad_steps_per_s": {"kind": "gauge"},
     "ewma_env_fps": {"kind": "gauge"},
     "ewma_ingest_rows_per_s": {"kind": "gauge"},
+    # learning-health plane (obs/learning.py, ISSUE 10): in-graph
+    # diagnostics computed inside the learner jits, host-read only at
+    # existing sync points. The four warn rows mirror LearnMonitor's
+    # absolute rules exactly (Q_MAX_LIMIT / UPDATE_RATIO_MIN /
+    # ESS_FRAC_MIN / TOP_FRAC_MAX) so the offline report flags the same
+    # lines the online engine fires on. Per-tenant duplicates ride
+    # dynamic `learn/<env_id>/<name>` keys (regrouped by summarize(),
+    # invisible to lint by design — same policy as peer/ keys).
+    "learn_td_abs_p50": {"kind": "gauge"},
+    "learn_td_abs_p90": {"kind": "gauge"},
+    "learn_td_abs_p99": {"kind": "gauge"},
+    "learn_td_signed_mean": {"kind": "gauge"},
+    "learn_q_mean": {"kind": "gauge"},
+    "learn_q_max": {
+        "kind": "gauge",
+        "warn": ("value", 1_000.0,
+                 "|q_max| beyond ~1e3 in clipped-reward units is Q "
+                 "divergence — check lr, target sync cadence, and the "
+                 "overestimation gap trend")},
+    "learn_target_q_mean": {"kind": "gauge"},
+    "learn_q_gap": {"kind": "gauge"},
+    "learn_grad_norm": {"kind": "gauge"},
+    "learn_update_ratio": {
+        "kind": "gauge",
+        "warn": ("value_min", 1e-9,
+                 "||update||/||params|| below ~1e-9 means the optimizer "
+                 "is effectively frozen — dead gradients or a crushed "
+                 "lr schedule")},
+    "learn_is_ess_frac": {
+        "kind": "gauge",
+        "warn": ("value_min", 0.05,
+                 "IS effective sample size below 5% of the batch means "
+                 "a handful of transitions dominate every update — "
+                 "beta/alpha pathology")},
+    "learn_priority_top_frac": {
+        "kind": "gauge",
+        "warn": ("value", 0.5,
+                 "one transition holding over half the priority mass "
+                 "means the sampler has collapsed onto a single "
+                 "outlier")},
+    "learn_sample_age_p50": {"kind": "gauge"},
+    "learn_sample_age_p90": {"kind": "gauge"},
+    "learn_prio_staleness_frac": {"kind": "gauge"},
+    "learn_shard_td_mean_min": {"kind": "gauge"},
+    "learn_shard_td_mean_max": {"kind": "gauge"},
+    "learn_loss": {"kind": "hist"},
+    "learning_degradations": {"kind": "ctr"},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -157,10 +204,17 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     stalls: list[dict] = []
     disconnects: list[dict] = []
     perf_events: list[dict] = []
+    learn_events: list[dict] = []
     for rec in records:
         for k, v in rec.items():
             if v is not None:
                 latest[k] = v
+        if rec.get("learning_degradation") is not None:
+            learn_events.append({"step": rec.get("step"),
+                                 "rule": rec["learning_degradation"],
+                                 "tenant": rec.get("learn_tenant"),
+                                 "value": rec.get("learn_value"),
+                                 "baseline": rec.get("learn_baseline")})
         if rec.get("stall_component") is not None:
             stalls.append({"step": rec.get("step"),
                            "component": rec["stall_component"],
@@ -211,6 +265,16 @@ def summarize(records: list[dict]) -> dict[str, Any]:
              if k.startswith("hist/") and isinstance(v, dict)}
     gauges = {k[len("gauge/"):]: v for k, v in latest.items()
               if k.startswith("gauge/")}
+    # per-tenant learning health: `gauge/learn/<env_id>/<name>` keys
+    # (obs/learning.publish_learn) regroup into one dict per env family
+    # — 57-game suite = 57 attributable tenants
+    tenants: dict[str, dict[str, Any]] = {}
+    for k, v in gauges.items():
+        if not k.startswith("learn/"):
+            continue
+        parts = k.split("/", 2)
+        if len(parts) == 3:
+            tenants.setdefault(parts[1], {})[parts[2]] = v
     ctrs = {k[len("ctr/"):]: v for k, v in latest.items()
             if k.startswith("ctr/")}
     hbm = {k[len("hbm/"):]: v for k, v in latest.items()
@@ -236,10 +300,12 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "hbm": hbm,
         "peers": peers,
         "multichip": multichip,
+        "tenants": tenants,
         "virtual_devices": latest.get("virtual_devices"),
         "disconnects": disconnects,
         "stalls": stalls,
         "perf_events": perf_events,
+        "learn_events": learn_events,
     }
 
 
@@ -340,9 +406,11 @@ def _fmt_slo(summary: dict[str, Any]) -> list[str]:
     hists = summary.get("hists", {})
     gauges = summary.get("gauges", {})
     lat = hists.get("infer_latency_ms")
+    # learn_* warn rows render (and flag) in the learning-health
+    # section instead — keep the SLO block serving-scoped
     gauge_rows = [(name, gauges[name]) for name, row in INSTRUMENTS.items()
                   if row["kind"] == "gauge" and "warn" in row
-                  and name in gauges]
+                  and name in gauges and not name.startswith("learn_")]
     if not lat and not gauge_rows:
         return []
     lines = ["serving SLOs:"]
@@ -473,6 +541,90 @@ def _fmt_multichip(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+# the four learn_* gauges with warn rows, i.e. the lines LearnMonitor's
+# absolute rules fire on — flagged here with the same bounds
+_LEARN_WARN_ROWS = ("learn_q_max", "learn_update_ratio",
+                    "learn_is_ess_frac", "learn_priority_top_frac")
+
+
+def _fmt_learning(summary: dict[str, Any]) -> list[str]:
+    """Learning-health section (obs/learning.py): the in-graph training
+    diagnostics at the last publish, healthy-range flags mirroring the
+    LearnMonitor rules, and the per-tenant (per-env-family) view."""
+    gauges = summary.get("gauges", {})
+    if not any(k.startswith("learn_") for k in gauges):
+        return []
+
+    def g(name: str) -> str:
+        v = gauges.get(name)
+        return _n(float(v)) if v is not None else "-"
+
+    lines = [
+        "learning health (in-graph diagnostics, last publish):",
+        f"  td |error|            p50={g('learn_td_abs_p50')} "
+        f"p90={g('learn_td_abs_p90')} p99={g('learn_td_abs_p99')} "
+        f"signed_mean={g('learn_td_signed_mean')}",
+        f"  Q values              mean={g('learn_q_mean')} "
+        f"max={g('learn_q_max')} target_mean={g('learn_target_q_mean')} "
+        f"overestimation_gap={g('learn_q_gap')}",
+        f"  optimizer             grad_norm={g('learn_grad_norm')} "
+        f"update_ratio={g('learn_update_ratio')}",
+        f"  sampling              is_ess_frac={g('learn_is_ess_frac')} "
+        f"age_p50={g('learn_sample_age_p50')} "
+        f"age_p90={g('learn_sample_age_p90')} "
+        f"priority_top_frac={g('learn_priority_top_frac')} "
+        f"prio_staleness={g('learn_prio_staleness_frac')}",
+    ]
+    if "learn_shard_td_mean_min" in gauges:
+        lines.append(
+            f"  shards (dp)           td_mean "
+            f"min={g('learn_shard_td_mean_min')} "
+            f"max={g('learn_shard_td_mean_max')}")
+    for name in _LEARN_WARN_ROWS:
+        if name not in gauges:
+            continue
+        kind, bound, why = HEALTHY[name]
+        low_side = kind == "value_min"
+        v = float(gauges[name])
+        if (v < bound) if low_side else (abs(v) > bound):
+            verb = "falls below" if low_side else "exceeds"
+            lines.append(f"    ⚠ {name}={_n(v)} {verb} healthy "
+                         f"~{_n(float(bound))}: {why}")
+    tenants = summary.get("tenants", {})
+    if tenants:
+        lines.append(f"  tenants ({len(tenants)}):")
+        for t in sorted(tenants):
+            d = tenants[t]
+
+            def tn(key: str, d=d) -> str:
+                v = d.get(key)
+                return _n(float(v)) if v is not None else "-"
+
+            lines.append(
+                f"    {t:<22} td_p90={tn('td_abs_p90')} "
+                f"q_mean={tn('q_mean')} q_max={tn('q_max')} "
+                f"ess={tn('is_ess_frac')} "
+                f"update_ratio={tn('update_ratio')}")
+    return lines
+
+
+def _fmt_learn_events(summary: dict[str, Any]) -> list[str]:
+    """LearnMonitor `learning_degradation` events (warn-only; the run
+    continued), attributed to the env family that tripped the rule."""
+    events = summary.get("learn_events", [])
+    if not events:
+        return []
+    lines = [f"learning-degradation events: {len(events)} (warn-only; "
+             f"the run continued)"]
+    for e in events:
+        who = f" tenant={e['tenant']}" if e.get("tenant") else ""
+        base = (f" baseline={_n(e['baseline'])}"
+                if e.get("baseline") else "")
+        lines.append(f"  step={_n(e['step'])} {e['rule']}{who}: "
+                     f"value={_n(e['value'])}{base}")
+    return lines
+
+
 def _fmt_perf_events(summary: dict[str, Any]) -> list[str]:
     """PerfDegradation events (warn-only EWMA regression engine), with
     peer attribution when the baseline was a fleet peer's."""
@@ -575,6 +727,14 @@ def format_report(summary: dict[str, Any]) -> str:
         lines.append("staleness / distribution percentiles:")
         for name in sorted(summary["hists"]):
             lines.extend(_fmt_hist(name, summary["hists"][name]))
+    learn_lines = _fmt_learning(summary)
+    if learn_lines:
+        lines.append("")
+        lines.extend(learn_lines)
+    learn_ev_lines = _fmt_learn_events(summary)
+    if learn_ev_lines:
+        lines.append("")
+        lines.extend(learn_ev_lines)
     slo_lines = _fmt_slo(summary)
     if slo_lines:
         lines.append("")
@@ -608,6 +768,44 @@ def format_report(summary: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def check_violations(summary: dict[str, Any]) -> list[str]:
+    """Every healthy-range row violated by the summary, one line each.
+    This is the CI gate (`--check`): the online engines (PerfMonitor,
+    LearnMonitor) stay warn-only by design; a lane that wants to FAIL
+    on an unhealthy artifact runs the report over it and exits on the
+    same rows the text report flags."""
+    gauges = summary.get("gauges", {})
+    hists = summary.get("hists", {})
+    out: list[str] = []
+    for name, (kind, bound, why) in HEALTHY.items():
+        row_kind = INSTRUMENTS[name]["kind"]
+        if row_kind == "gauge":
+            raw = gauges.get(name)
+            if raw is None:
+                continue
+            v = float(raw)
+            if kind == "value_min":
+                bad = v < bound
+                rel = "<"
+            else:
+                # q blowup is a magnitude rule (divergence to -inf is
+                # just as dead as +inf) — mirror LearnMonitor exactly
+                bad = (abs(v) if name == "learn_q_max" else v) > bound
+                rel = ">"
+            if bad:
+                out.append(f"{name}: value={_n(v)} {rel} healthy "
+                           f"{_n(float(bound))} — {why}")
+        else:  # hist rows warn on a percentile
+            h = hists.get(name)
+            if not isinstance(h, dict) or not int(h.get("count", 0)):
+                continue
+            v = h.get(kind)
+            if v is not None and float(v) > bound:
+                out.append(f"{name}: {kind}={_n(float(v))} > healthy "
+                           f"{_n(float(bound))} — {why}")
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m ape_x_dqn_tpu.obs.report",
@@ -618,6 +816,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of the text report")
+    ap.add_argument("--check", action="store_true",
+                    help="health-gate mode: print the report, then "
+                         "exit 2 if any healthy-range row is violated "
+                         "(the warn-only online engines never abort; "
+                         "this is the CI-facing gate)")
     ap.add_argument("--follow", action="store_true",
                     help="live-tail mode: re-summarize and re-print "
                          "whenever the JSONL grows (the fleet "
@@ -634,6 +837,16 @@ def main(argv: list[str] | None = None) -> int:
         summary = summarize(records)
         print(json.dumps(summary) if args.json
               else format_report(summary))
+        if args.check:
+            violations = check_violations(summary)
+            if violations:
+                print("\nhealth check: FAILED "
+                      f"({len(violations)} healthy-range violations)",
+                      file=sys.stderr)
+                for v in violations:
+                    print(f"  ✗ {v}", file=sys.stderr)
+                return 2
+            print("\nhealth check: ok — all healthy-range rows pass")
         return 0
     return _follow(args.jsonl, args.interval, args.json)
 

@@ -76,8 +76,17 @@ def make_dqn_loss(net_apply: Callable, double: bool = True,
                           double=double, rescale=rescale)
         per_sample = huber(td, huber_delta)
         loss = jnp.mean(is_weights * per_sample)
+        # learning-health diagnostics (obs/learning.py): the online-max
+        # vs target-net bootstrap gap is the overestimation Double-DQN
+        # exists to shrink (van Hasselt 2016). XLA CSEs the argmax /
+        # gather with dqn_td_error's identical internals.
+        a_star = jnp.argmax(q_sp_online, axis=-1)
+        boot_t = jnp.take_along_axis(
+            q_sp_target, a_star[:, None], axis=-1)[:, 0]
         aux = {"td_abs": jnp.abs(td), "loss_per_sample": per_sample,
-               "q_mean": q_s.mean()}
+               "q_mean": q_s.mean(), "td_mean": td.mean(),
+               "q_max": q_s.max(), "target_q_mean": boot_t.mean(),
+               "q_gap": (jnp.max(q_sp_online, axis=-1) - boot_t).mean()}
         return loss, aux
 
     return loss_fn
@@ -193,8 +202,16 @@ def make_r2d2_loss(net_apply_seq: Callable, burn_in: int, n_step: int,
         max_td = td_abs.max(axis=1)
         mean_td = td_abs.sum(axis=1) / denom
         priorities = priority_eta * max_td + (1 - priority_eta) * mean_td
+        # learning-health diagnostics: valid-masked means so padding
+        # never dilutes the statistics (td is already valid-masked)
+        vsum = jnp.maximum(valid.sum(), 1.0)
         aux = {"td_abs": priorities, "q_mean": q_sa.mean(),
-               "valid_frac": valid.mean()}
+               "valid_frac": valid.mean(),
+               "td_mean": td.sum() / vsum,
+               "q_max": q_online.max(),
+               "target_q_mean": (target * valid).sum() / vsum,
+               "q_gap": ((jnp.max(q_online, axis=-1) - boot)
+                         * valid).sum() / vsum}
         return loss, aux
 
     return loss_fn
@@ -224,7 +241,12 @@ def make_dpg_losses(actor_apply: Callable, critic_apply: Callable):
         q = critic_apply(critic_params, batch.obs, batch.actions)
         td = q - jax.lax.stop_gradient(target)
         loss = jnp.mean(is_weights * 0.5 * td**2)
-        return loss, {"td_abs": jnp.abs(td), "q_mean": q.mean()}
+        # q_gap here is critic-vs-bootstrap bias (equals td_mean by
+        # construction — there is no separate online-max estimate)
+        return loss, {"td_abs": jnp.abs(td), "q_mean": q.mean(),
+                      "td_mean": td.mean(), "q_max": q.max(),
+                      "target_q_mean": target.mean(),
+                      "q_gap": (q - target).mean()}
 
     def policy_loss(actor_params: Any, critic_params: Any,
                     batch: ContinuousBatch):

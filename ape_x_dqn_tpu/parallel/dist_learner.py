@@ -43,6 +43,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ape_x_dqn_tpu.obs import learning as learn_obs
 from ape_x_dqn_tpu.ops.losses import (
     TransitionBatch, make_dqn_loss, make_r2d2_loss)
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay, ReplayState
@@ -183,9 +184,10 @@ class _DistLearnerBase:
         training batch is normalized over exactly its own draws."""
         w = w / jnp.maximum(w.max(), 1e-12)
         batch = self._make_batch(jax.tree.map(self._flat, items))
+        wf = self._flat(w)
         (loss, aux), grads = jax.value_and_grad(
             self.loss_fn, has_aux=True)(
-            params, target_params, batch, self._flat(w))
+            params, target_params, batch, wf)
         updates, opt_state = self.optimizer.update(
             grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -194,9 +196,18 @@ class _DistLearnerBase:
         target_params = jax.tree.map(
             lambda t, p: jnp.where(sync, p, t), target_params, params)
         td_shard = aux["td_abs"].reshape(self.dp, self.b_local)
+        # learning-health scalars: the flat reductions inside sgd_diag
+        # run over the [dp]-sharded batch, so GSPMD lowers them to the
+        # psum'd GLOBAL statistics; the per-shard mean-|TD| min/max
+        # exposes shard skew the global mean would average away
+        shard_means = td_shard.mean(axis=1)
+        diag = learn_obs.sgd_diag(aux, wf, grads, updates, params)
+        diag["shard_td_mean_min"] = shard_means.min()
+        diag["shard_td_mean_max"] = shard_means.max()
         metrics = {"loss": loss, "q_mean": aux["q_mean"],
                    "td_abs_mean": aux["td_abs"].mean(),
-                   "grad_norm": optax.global_norm(grads)}
+                   "grad_norm": optax.global_norm(grads),
+                   "diag": diag}
         return params, target_params, opt_state, step, td_shard, metrics
 
     def _train_step(self, state: DistTrainState
@@ -207,6 +218,11 @@ class _DistLearnerBase:
         params, target_params, opt_state, step, td_shard, metrics = \
             self._sgd_step(state.params, state.target_params,
                            state.opt_state, state.step, items, w)
+        # fused path: draw and write-back see the same shard trees, so
+        # the priority-staleness delta is identically 0 (pri_then=None)
+        metrics["diag"] = {**metrics.get("diag", {}),
+                           **learn_obs.replay_health_sharded(
+                               self.replay, state.replay, idx, None)}
         # per-shard priority write-back
         new_replay = jax.vmap(
             lambda rs, i, td: self.replay.update_priorities(rs, i, td)
@@ -222,9 +238,13 @@ class _DistLearnerBase:
 
         -> (items_k [K, dp, b_local, ...], idx [dp, K*b_local]
         UN-chunked for the per-shard write-back, w_k [K, dp, b_local]
-        raw — _sgd_step max-normalizes per training batch)."""
+        raw — _sgd_step max-normalizes per training batch, and
+        pri [dp, K*b_local] descent-time leaf priorities appended LAST
+        for the staleness delta — positional readers of the tuple's
+        stable prefix are unmoved)."""
         items, idx, w = self._sample_weighted(replay_state, sk,
                                               k * self.b_local)
+        pri = jax.vmap(self.replay.leaf_priorities)(replay_state, idx)
 
         def chunked(x):
             # [dp, b_local*k, ...] -> [k, dp, b_local, ...] with chunk
@@ -234,7 +254,7 @@ class _DistLearnerBase:
 
         items_k = jax.tree.map(chunked, items)
         w_k = chunked(w)
-        return items_k, idx, w_k
+        return items_k, idx, w_k, pri
 
     def _learn_stage(self, state: DistTrainState, sample,
                      k: int) -> tuple[DistTrainState, dict]:
@@ -242,7 +262,7 @@ class _DistLearnerBase:
         + ONE vmapped per-shard write-back + target sync (static
         unrolled loop — lax.scan conv bodies are pathologically slow
         on CPU). `state.rng` must already be advanced past the draw."""
-        items_k, idx, w_k = sample
+        items_k, idx, w_k, pri_k = sample
         params, target_params, opt_state, step = (
             state.params, state.target_params, state.opt_state,
             state.step)
@@ -254,6 +274,12 @@ class _DistLearnerBase:
                 self._sgd_step(params, target_params, opt_state, step,
                                it, w_k[j])
             td_parts.append(td_shard)
+        # write-back-time replay health: the shard trees NOW vs the
+        # descent-time priorities pri_k — the measured staleness the
+        # prefetch/K-batch relaxations accept (ROADMAP item 3)
+        metrics["diag"] = {**metrics.get("diag", {}),
+                           **learn_obs.replay_health_sharded(
+                               self.replay, state.replay, idx, pri_k)}
         # invert the chunk transform: td_all[d, i*k + j] = parts[j][d, i]
         td_all = jnp.moveaxis(jnp.stack(td_parts, axis=0), 0, 2) \
             .reshape(self.dp, k * self.b_local)

@@ -346,6 +346,11 @@ class FrameRingReplay(PrioritizedReplay):
         """[B] f32: 1 on live transitions, 0 on dead pad slots."""
         return (state.storage["next_off"][idx] > 0).astype(jnp.float32)
 
+    def cursor_transitions(self, state: ReplayState) -> jax.Array:
+        """Write cursor in transition units (the frame ring's `pos`
+        counts segments) — the learning-health age statistic's clock."""
+        return state.pos * self.B
+
     def live_transitions(self, state: ReplayState) -> jax.Array:
         """Count of live (non-pad) transition slots, reducing only the
         trailing slot axis — so it works unchanged on a single-chip

@@ -207,6 +207,23 @@ class PrioritizedReplay:
         slots; the frame-ring layout overrides this (pad slots)."""
         return jnp.ones(idx.shape, jnp.float32)
 
+    # -- learning-health accessors (obs/learning.py; pure, jit-safe) -------
+
+    # static capability flag: UniformReplayDevice sets False so the
+    # learner's diag tap specializes away the priority statistics
+    has_priorities = True
+
+    def leaf_priorities(self, state: ReplayState,
+                        idx: jax.Array) -> jax.Array:
+        """Stored p^alpha at the given leaf indices (any idx shape)."""
+        return state.tree[self.capacity + idx]
+
+    def cursor_transitions(self, state: ReplayState) -> jax.Array:
+        """Write cursor in TRANSITION (= leaf-index) units, so ring
+        distance to a sampled leaf is its age in transitions. The
+        frame-ring layout overrides (its cursor counts segments)."""
+        return state.pos
+
     # -- split entry points (double-buffered learner pipeline) -------------
 
     def sample_state(self, state: ReplayState, rng: jax.Array, batch: int
@@ -298,6 +315,16 @@ class UniformReplayDevice:
 
     def update_priorities(self, state: ReplayState, idx, td_abs):
         return state
+
+    # learning-health accessors: no tree, so priority statistics are
+    # statically skipped by the learner's diag tap
+    has_priorities = False
+
+    def leaf_priorities(self, state: ReplayState, idx):
+        return jnp.zeros(idx.shape, jnp.float32)
+
+    def cursor_transitions(self, state: ReplayState):
+        return state.pos
 
     # split entry points (see PrioritizedReplay): sampling is uniform
     # and updates are no-ops, so the commuting contract holds trivially

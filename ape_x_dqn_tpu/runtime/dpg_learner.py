@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import optax
 
 from ape_x_dqn_tpu.models.base import soft_update
+from ape_x_dqn_tpu.obs import learning as learn_obs
 from ape_x_dqn_tpu.ops.losses import ContinuousBatch, make_dpg_losses
 from ape_x_dqn_tpu.replay.prioritized import ReplayState
 
@@ -134,6 +135,12 @@ class DPGLearner:
             "q_mean": c_aux["q_mean"],
             "td_abs_mean": c_aux["td_abs"].mean(),
             "a_abs_mean": p_aux["a_abs_mean"],
+            # learning-health scalars over the CRITIC update (the TD
+            # learner); fused path, so staleness is identically 0
+            "diag": {**learn_obs.sgd_diag(c_aux, is_w, c_grads,
+                                          c_updates, critic_params),
+                     **learn_obs.replay_health(
+                         self.replay, state.replay, idx, None)},
         }
         new_state = DPGTrainState(
             actor_params, critic_params, target_actor, target_critic,

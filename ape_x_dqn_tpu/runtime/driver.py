@@ -1097,6 +1097,14 @@ class ApexDriver:
                 if "td_abs_mean" in m:
                     self.obs.observe("td_abs", float(m["td_abs_mean"]))
                 self.obs.gauge("replay_occupancy", replay_size)
+                if self.obs.enabled and "diag" in m:
+                    # learning-health plane: m is already synced above
+                    # (block_until_ready under obs), so these reads add
+                    # no device round-trips; tenant = env family
+                    self.obs.learn_health(
+                        m["diag"], float(m["loss"]),
+                        step=self._grad_steps_total,
+                        tenant=self.cfg.env.id)
                 if self.is_dist:
                     # lockstep ingest fills every shard equally, so the
                     # live bounds come from the host fill mirror (no

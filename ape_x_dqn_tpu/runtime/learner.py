@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ape_x_dqn_tpu.obs import learning as learn_obs
 from ape_x_dqn_tpu.ops.losses import TransitionBatch, make_dqn_loss
 from ape_x_dqn_tpu.replay.prioritized import ReplayState
 
@@ -103,6 +104,11 @@ class SingleChipLearner:
         params, target_params, opt_state, step, td_abs, metrics = \
             self._sgd_step(state.params, state.target_params,
                            state.opt_state, state.step, items, is_w)
+        # fused path: draw and write-back see the same tree, so the
+        # priority-staleness delta is identically 0 (pri_then=None)
+        metrics["diag"] = {**metrics.get("diag", {}),
+                           **learn_obs.replay_health(
+                               self.replay, state.replay, idx, None)}
         replay_state = self.replay.update_priorities(
             state.replay, idx, td_abs)
         new_state = TrainState(params, target_params, opt_state,
@@ -118,11 +124,16 @@ class SingleChipLearner:
         cursor), so a prefetched call commutes with an in-flight
         priority write-back — the double-buffering contract.
 
-        -> (items_k [K, B, ...] pytree, idx_k [K, B], is_w_k [K, B])
+        -> (items_k [K, B, ...] pytree, idx_k [K, B], is_w_k [K, B],
+            pri_k [K, B] descent-time leaf priorities — the staleness
+            reference _learn_stage compares against at write-back time;
+            appended LAST so positional readers of the tuple's stable
+            prefix, e.g. single_process.py's `sample[1]`, are unmoved)
         """
         b = self.lcfg.batch_size
         items, idx, is_w = self.replay.sample_state(replay_state, sk,
                                                     k * b)
+        pri = self.replay.leaf_priorities(replay_state, idx)
 
         # stratum i of the K*B descent covers cumulative-mass slice
         # [i, i+1)/(K*B) over leaves in ring-insertion order, so chunk
@@ -140,7 +151,7 @@ class SingleChipLearner:
         is_w_k = chunked(is_w)
         is_w_k = is_w_k / jnp.maximum(
             is_w_k.max(axis=1, keepdims=True), 1e-12)
-        return items_k, idx_k, is_w_k
+        return items_k, idx_k, is_w_k, chunked(pri)
 
     def _learn_stage(self, state: TrainState, sample,
                      k: int) -> tuple[TrainState, dict]:
@@ -155,7 +166,7 @@ class SingleChipLearner:
         there), while unrolled code also gives XLA's scheduler the
         whole window to overlap."""
         b = self.lcfg.batch_size
-        items_k, idx_k, is_w_k = sample
+        items_k, idx_k, is_w_k, pri_k = sample
         params, target_params, opt_state, step = (
             state.params, state.target_params, state.opt_state,
             state.step)
@@ -167,6 +178,13 @@ class SingleChipLearner:
                 self._sgd_step(params, target_params, opt_state, step,
                                it, is_w_k[j])
             td_parts.append(td_abs)
+        # write-back-time replay health: state.replay's tree is what
+        # the sampler would see NOW, pri_k is what it saw at descent
+        # time — their delta is the measured priority staleness the
+        # prefetch/K-batch relaxations accept (ROADMAP item 3)
+        metrics["diag"] = {**metrics.get("diag", {}),
+                           **learn_obs.replay_health(
+                               self.replay, state.replay, idx_k, pri_k)}
         # td_parts[j] pairs with idx_k[j] (chunk order), so flatten
         # idx_k the same way for the single write-back
         replay_state = self.replay.update_state(
@@ -385,6 +403,11 @@ class DQNLearner(SingleChipLearner):
             "q_mean": aux["q_mean"],
             "td_abs_mean": aux["td_abs"].mean(),
             "grad_norm": optax.global_norm(grads),
+            # learning-health scalars (obs/learning.py); rides the
+            # metrics pytree through every scan, read at existing
+            # host sync points only
+            "diag": learn_obs.sgd_diag(aux, is_w, grads, updates,
+                                       params),
         }
         return params, target_params, opt_state, step, aux["td_abs"], \
             metrics

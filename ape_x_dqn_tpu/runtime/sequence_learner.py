@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from ape_x_dqn_tpu.obs import learning as learn_obs
 from ape_x_dqn_tpu.ops.losses import make_r2d2_loss
 from ape_x_dqn_tpu.replay.sequence import batch_to_sequence_batch
 from ape_x_dqn_tpu.runtime.learner import (SingleChipLearner, TrainState,
@@ -72,6 +73,10 @@ class SequenceLearner(SingleChipLearner):
             "td_abs_mean": aux["td_abs"].mean(),
             "valid_frac": aux["valid_frac"],
             "grad_norm": optax.global_norm(grads),
+            # learning-health scalars; td quantiles here are over the
+            # eta-mixed per-sequence priorities (the write-back signal)
+            "diag": learn_obs.sgd_diag(aux, is_w, grads, updates,
+                                       params),
         }
         return params, target_params, opt_state, step, aux["td_abs"], \
             metrics

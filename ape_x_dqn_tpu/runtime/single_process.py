@@ -252,6 +252,13 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
                         grad_steps // pub_every:
                     obs_.gauge("replay_occupancy",
                                int(state.replay.size))
+                    if obs_.enabled and "diag" in m:
+                        # learning-health plane: observed runs go
+                        # through traced_train, which already
+                        # block_until_ready'd m — no extra sync here
+                        obs_.learn_health(
+                            m["diag"], float(m["loss"]),
+                            step=grad_steps, tenant=cfg.env.id)
                     now = time.monotonic()
                     if now > rate_t:
                         dt = now - rate_t

@@ -571,23 +571,33 @@ def bench_prefetch_ab(args) -> dict:
     orders (PERF.md 'Prefetch A/B')."""
     spd, disp = args.ab_steps_per_dispatch, args.ab_dispatches
 
-    def flat_arm(prefetch: bool) -> list[float]:
+    def _staleness(learner, state) -> float | None:
+        """Measured priority-staleness fraction (obs/learning.py) from
+        one extra already-compiled dispatch: the in-graph delta between
+        descent-time and write-back-time priorities — identically 0 on
+        the fused arm, the quantified one-macro-step lag under prefetch
+        (the number ROADMAP item 3 said to measure, not assume)."""
+        _, m = learner.train_many(state, spd)
+        v = m.get("diag", {}).get("prio_staleness_frac")
+        return None if v is None else float(f"{float(v):.4g}")
+
+    def flat_arm(prefetch: bool) -> tuple[list[float], float | None]:
         _, learner, state, _spec = build_learner(
             args.ab_capacity, args.ab_batch_size, args.storage,
             args.sample_chunk, sample_prefetch=prefetch)
         state, _ = prefill(learner, state, _spec,
                            max(args.ab_capacity // 2, 4096), args.storage,
                            repeats=1)
-        rates, _ = bench_learner(learner, state, spd, disp,
-                                 repeats=args.repeats)
-        return rates
+        rates, state = bench_learner(learner, state, spd, disp,
+                                     repeats=args.repeats)
+        return rates, _staleness(learner, state)
 
-    def seq_arm(prefetch: bool) -> list[float]:
+    def seq_arm(prefetch: bool) -> tuple[list[float], float | None]:
         learner, state = _build_seq_learner(
             args.ab_batch_size, args.sample_chunk, prefetch)
-        rates, _ = bench_learner(learner, state, spd, disp,
-                                 repeats=args.repeats)
-        return rates
+        rates, state = bench_learner(learner, state, spd, disp,
+                                     repeats=args.repeats)
+        return rates, _staleness(learner, state)
 
     out = {"sample_chunk": args.sample_chunk,
            "batch_size": args.ab_batch_size,
@@ -596,12 +606,17 @@ def bench_prefetch_ab(args) -> dict:
         orders = {}
         for order in ("off_first", "on_first"):
             first = order == "off_first"
-            a = arm(not first)   # off when off_first
-            b = arm(first)       # on when off_first
+            a, a_stale = arm(not first)   # off when off_first
+            b, b_stale = arm(first)       # on when off_first
             off, on = (a, b) if first else (b, a)
-            orders[order] = {"off": spread(off), "on": spread(on)}
+            off_st, on_st = ((a_stale, b_stale) if first
+                             else (b_stale, a_stale))
+            orders[order] = {"off": spread(off), "on": spread(on),
+                             "prio_staleness_frac": {"off": off_st,
+                                                     "on": on_st}}
             log(f"prefetch A/B [{name}/{order}]: off "
-                f"{spread(off)} vs on {spread(on)} grad-steps/s")
+                f"{spread(off)} vs on {spread(on)} grad-steps/s "
+                f"(prio staleness off={off_st} on={on_st})")
         d = [100.0 * (orders[o]["on"]["median"] / orders[o]["off"]["median"]
                       - 1.0) for o in orders]
         out[name] = {**orders,
@@ -1088,6 +1103,99 @@ def bench_chaos_ab(args) -> dict:
         f"decode errors {out['chaos']['wire_decode_errors']}, "
         f"epochs converged {out['chaos']['epochs_converged']})")
     return out
+
+
+def bench_learn_health(args) -> None:
+    """Learning-health smoke lane (ISSUE 10): short REAL training runs
+    (one per env family = tenant) through the single-process driver
+    with the obs plane on, all appending to ONE metrics JSONL. The
+    stream is then summarized in-process: the lane's verdict per game
+    is `obs/report.py check_violations` over its tenant's gauges, and
+    the artifact is SUITE_LEARN-shaped (games/scores/per_game/complete)
+    so suite tooling can diff health the way it diffs scores. The CI
+    gate is `python -m ape_x_dqn_tpu.obs.report <jsonl> --check`
+    (tests/run_chunked.sh) — the online LearnMonitor stays warn-only."""
+    from ape_x_dqn_tpu.configs import (EnvConfig, LearnerConfig,
+                                       NetworkConfig, ObsConfig,
+                                       ReplayConfig, get_config)
+    from ape_x_dqn_tpu.obs import report as obs_report
+    from ape_x_dqn_tpu.runtime.single_process import train_single_process
+    from ape_x_dqn_tpu.utils.metrics import Metrics
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    jsonl = os.path.join(here, "LEARN_HEALTH_SMOKE.jsonl")
+    if os.path.exists(jsonl):
+        os.remove(jsonl)  # Metrics appends; one artifact per lane run
+    games = ["catch", "pong"]
+    per_game: dict[str, dict] = {}
+    scores: dict[str, float] = {}
+    complete = True
+    for game in games:
+        cfg = get_config("pong").replace(
+            env=EnvConfig(id=game, kind="synthetic_atari"),
+            network=NetworkConfig(kind="nature_cnn", dueling=True,
+                                  compute_dtype="float32"),
+            replay=ReplayConfig(kind="prioritized", capacity=2048,
+                                min_fill=300),
+            learner=LearnerConfig(batch_size=16, n_step=3,
+                                  target_sync_every=16, sample_chunk=2),
+            obs=ObsConfig(enabled=True, publish_every_steps=50,
+                          heartbeat_timeout_s=120.0))
+        metrics = Metrics(log_path=jsonl)
+        t0 = time.monotonic()
+        out = train_single_process(cfg, total_env_frames=args.lh_frames,
+                                   metrics=metrics, train_every=2)
+        metrics.close()
+        wall = time.monotonic() - t0
+        log(f"learn-health [{game}]: {out['grad_steps']} grad-steps / "
+            f"{out['frames']} frames in {wall:.1f}s, avg_return "
+            f"{out['avg_return']:.2f}")
+        summary = obs_report.summarize(obs_report.load_records(jsonl))
+        tenant = summary["tenants"].get(game, {})
+        events = [e for e in summary["learn_events"]
+                  if e.get("tenant") == game]
+        violations = obs_report.check_violations(summary)
+        per_game[game] = {
+            "game": game,
+            "frames": out["frames"],
+            "grad_steps": out["grad_steps"],
+            "avg_return": round(out["avg_return"], 3),
+            "wall_s": round(wall, 1),
+            "learn": {k: float(f"{float(v):.4g}")
+                      for k, v in sorted(tenant.items())},
+            "degradation_events": len(events),
+            "healthy": not violations,
+        }
+        scores[game] = round(out["avg_return"], 3)
+        complete = (complete and out["grad_steps"] > 0 and bool(tenant))
+    summary = obs_report.summarize(obs_report.load_records(jsonl))
+    violations = obs_report.check_violations(summary)
+    healthy_games = sum(1 for p in per_game.values() if p["healthy"])
+    result = {
+        "metric": "learn_health_games_healthy",
+        "value": round(healthy_games / len(games), 3),
+        "unit": "frac",
+        "games": games,
+        "scores": scores,
+        "per_game": per_game,
+        "complete": complete,
+        "violations": violations,
+        "degradation_events": len(summary["learn_events"]),
+        "metrics_jsonl": os.path.basename(jsonl),
+    }
+    line = json.dumps(result)
+    path = os.path.join(here, "LEARN_HEALTH_SMOKE.json")
+    try:
+        with open(path, "w") as fh:
+            fh.write(line + "\n")
+    except OSError as e:
+        log(f"could not write learn-health artifact {path}: {e!r}")
+    log(f"learn-health metrics JSONL -> {jsonl} (gate with `python -m "
+        f"ape_x_dqn_tpu.obs.report {os.path.basename(jsonl)} --check`)")
+    print(line, flush=True)
+    # exit nonzero only when the RUNS failed to produce the plane; an
+    # unhealthy-but-present plane is the report --check gate's call
+    raise SystemExit(0 if complete else 1)
 
 
 def wire_codec_summary() -> dict:
@@ -1764,6 +1872,18 @@ def main() -> None:
                    "scaling'). Accepts '1,2,4,8' or 'dp=1,2,4,8'")
     p.add_argument("--multichip-child", type=int, default=None,
                    metavar="DP", help=argparse.SUPPRESS)
+    p.add_argument("--learn-health", action="store_true",
+                   help="run the learning-health smoke lane INSTEAD of "
+                   "the main bench: short real training runs (one per "
+                   "env family) through the single-process driver with "
+                   "the obs plane on, writing LEARN_HEALTH_SMOKE.jsonl "
+                   "+ a SUITE_LEARN-style LEARN_HEALTH_SMOKE.json with "
+                   "per-tenant learn_* gauges and health verdicts. "
+                   "Gate the JSONL with `python -m "
+                   "ape_x_dqn_tpu.obs.report ... --check`")
+    p.add_argument("--lh-frames", type=int, default=1400,
+                   help="env frames per game for the --learn-health "
+                   "lane")
     p.add_argument("--ab-batch-size", type=int, default=64,
                    help="batch size for the prefetch A/B arms (small "
                    "enough to iterate on a CPU host; raise on a real "
@@ -1809,6 +1929,7 @@ def main() -> None:
         args.ab_steps_per_dispatch = min(args.ab_steps_per_dispatch, 4)
         args.ab_dispatches = min(args.ab_dispatches, 2)
         args.chaos_ab_seconds = min(args.chaos_ab_seconds, 2.0)
+        args.lh_frames = min(args.lh_frames, 800)
     # the baseline must be read BEFORE _emit overwrites the artifact
     args._baseline = (_load_baseline(args.smoke) if args.perf_gate
                       else (None, None))
@@ -1820,6 +1941,9 @@ def main() -> None:
         return
     if args.multichip:
         bench_multichip(args)
+        return
+    if args.learn_health:
+        bench_learn_health(args)
         return
     log(f"devices: {jax.devices()}")
     if args.prefetch_ab:
@@ -1904,6 +2028,15 @@ def main() -> None:
         "wire_codec": wire_codec_summary(),
         "telemetry": telemetry_summary(args),
     }
+    # learning-health snapshot (obs/learning.py): the in-graph diag
+    # pytree from one extra already-compiled dispatch, so every BENCH
+    # artifact records what the training math looked like at capture
+    # time next to how fast it ran
+    state, m = learner.train_many(state, args.steps_per_dispatch)
+    jax.block_until_ready(m["loss"])
+    if "diag" in m:
+        secondary["learn_health"] = {
+            k: float(f"{float(v):.4g}") for k, v in m["diag"].items()}
     flops = train_step_flops_analytic(args.batch_size)
     achieved_tflops = gsps * flops / 1e12
     mfu = achieved_tflops / args.peak_tflops

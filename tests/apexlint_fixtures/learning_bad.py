@@ -1,0 +1,12 @@
+"""obs-names fixture: the two ways a learning-plane PR drifts.
+
+`learn_grad_norm` is emitted as a counter while the table lists a
+gauge (the report would look under ctr/ and never print it);
+`learn_scratch_frac` has no row at all (the report silently drops a
+new diagnostic).
+"""
+
+
+def publish_learn(obs, vals):
+    obs.count("learn_grad_norm", vals["grad_norm"])  # kind mismatch
+    obs.gauge("learn_scratch_frac", 0.0)  # no INSTRUMENTS row, no waiver

@@ -1,0 +1,42 @@
+"""obs-names fixture: the learning-health-plane emission shape.
+
+Mirrors obs/learning.py's publish_learn literal gauge sites plus the
+facade's learn_loss histogram and the monitor's degradation counter:
+every emission carries a row in the learning report fixture with the
+kind the registry publishes it under. The per-tenant duplicates ride
+dynamic f-string keys and are invisible to the checker by design (same
+policy as the fleet plane's peer/ keys).
+"""
+
+
+def publish_learn(obs, vals, tenant=""):
+    g = vals.get
+    obs.gauge("learn_td_abs_p50", g("td_abs_p50", 0.0))
+    obs.gauge("learn_td_abs_p90", g("td_abs_p90", 0.0))
+    obs.gauge("learn_td_abs_p99", g("td_abs_p99", 0.0))
+    obs.gauge("learn_td_signed_mean", g("td_signed_mean", 0.0))
+    obs.gauge("learn_q_mean", g("q_mean", 0.0))
+    obs.gauge("learn_q_max", g("q_max", 0.0))
+    obs.gauge("learn_target_q_mean", g("target_q_mean", 0.0))
+    obs.gauge("learn_q_gap", g("q_gap", 0.0))
+    obs.gauge("learn_grad_norm", g("grad_norm", 0.0))
+    obs.gauge("learn_update_ratio", g("update_ratio", 0.0))
+    obs.gauge("learn_is_ess_frac", g("is_ess_frac", 1.0))
+    obs.gauge("learn_priority_top_frac", g("priority_top_frac", 0.0))
+    obs.gauge("learn_sample_age_p50", g("sample_age_p50", 0.0))
+    obs.gauge("learn_sample_age_p90", g("sample_age_p90", 0.0))
+    obs.gauge("learn_prio_staleness_frac", g("prio_staleness_frac", 0.0))
+    if "shard_td_mean_min" in vals:
+        obs.gauge("learn_shard_td_mean_min", vals["shard_td_mean_min"])
+        obs.gauge("learn_shard_td_mean_max", vals["shard_td_mean_max"])
+    if tenant:
+        for k, v in vals.items():
+            obs.gauge(f"learn/{tenant}/{k}", v)
+
+
+def observe_loss(obs, loss):
+    obs.observe("learn_loss", loss)
+
+
+def fire_degradation(obs):
+    obs.count("learning_degradations")

@@ -1,0 +1,28 @@
+"""obs-names fixture: mini INSTRUMENTS table for the learning plane.
+
+Rows match learning_good.py's emissions; `learn_grad_norm` is listed
+as a gauge so learning_bad.py's counter emission is a kind-mismatch
+finding.
+"""
+
+INSTRUMENTS = {
+    "learn_td_abs_p50": {"kind": "gauge"},
+    "learn_td_abs_p90": {"kind": "gauge"},
+    "learn_td_abs_p99": {"kind": "gauge"},
+    "learn_td_signed_mean": {"kind": "gauge"},
+    "learn_q_mean": {"kind": "gauge"},
+    "learn_q_max": {"kind": "gauge"},
+    "learn_target_q_mean": {"kind": "gauge"},
+    "learn_q_gap": {"kind": "gauge"},
+    "learn_grad_norm": {"kind": "gauge"},
+    "learn_update_ratio": {"kind": "gauge"},
+    "learn_is_ess_frac": {"kind": "gauge"},
+    "learn_priority_top_frac": {"kind": "gauge"},
+    "learn_sample_age_p50": {"kind": "gauge"},
+    "learn_sample_age_p90": {"kind": "gauge"},
+    "learn_prio_staleness_frac": {"kind": "gauge"},
+    "learn_shard_td_mean_min": {"kind": "gauge"},
+    "learn_shard_td_mean_max": {"kind": "gauge"},
+    "learn_loss": {"kind": "hist"},
+    "learning_degradations": {"kind": "ctr"},
+}

@@ -60,6 +60,21 @@ if ! python bench.py --perf-gate --smoke; then
     failed_files+=("bench.py --perf-gate --smoke")
 fi
 
+# Learning-health smoke: two short synthetic-Atari tenants through the
+# single-process driver with obs on, then the report's --check mode
+# gates the published learn_* gauges against the INSTRUMENTS
+# healthy-range rows. The lane itself is warn-only (exit 0 as long as
+# the plane publishes); --check is where health becomes a hard gate.
+echo
+echo "=== bench.py --learn-health --smoke"
+if ! python bench.py --learn-health --smoke; then
+    fail=1
+    failed_files+=("bench.py --learn-health --smoke")
+elif ! python -m ape_x_dqn_tpu.obs.report LEARN_HEALTH_SMOKE.jsonl --check; then
+    fail=1
+    failed_files+=("obs.report LEARN_HEALTH_SMOKE.jsonl --check")
+fi
+
 # Multi-chip smoke: dp=1,2 over virtual devices (the lane
 # self-provisions --xla_force_host_platform_device_count in child
 # processes). Proves the sharded ingest/train path end-to-end and

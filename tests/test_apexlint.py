@@ -196,6 +196,25 @@ def test_obs_names_profiling_fixtures():
     assert len(bad.findings) == 2
 
 
+def test_obs_names_learning_fixtures():
+    """The learning-plane fixture pair (ISSUE 10): the good emitter's
+    publish_learn literal gauges + loss histogram + degradation counter
+    cross-reference cleanly (tenant-prefixed f-string keys invisible by
+    design); the bad emitter drifts both ways (grad_norm emitted as a
+    counter, an unlisted diagnostic gauge)."""
+    report = _fx("learning_report_fixture.py")
+    good = obs_names.check([_fx("learning_good.py")], report)
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = obs_names.check(
+        [_fx("learning_good.py"), _fx("learning_bad.py")], report)
+    msgs = [f.message for f in bad.findings]
+    assert any("learn_grad_norm" in m for m in msgs)  # gauge-vs-ctr
+    assert any("learn_scratch_frac" in m for m in msgs)  # unlisted
+    assert len(bad.findings) == 2
+
+
 def test_obs_names_multichip_fixtures():
     """The dp-scaling fixture pair (ISSUE 9): the good emitter's
     publish_multichip + train_dist literal gauges cross-reference
