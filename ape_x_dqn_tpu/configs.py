@@ -96,6 +96,47 @@ class ReplayConfig:
     # compat escape hatch: False restores the list-append +
     # concatenate-per-flush legacy staging path in runtime/driver.py
     ingest_zero_copy: bool = True
+    # -- tiered cold store (replay/cold_store.py), default OFF ----------
+    # cold_tier_capacity > 0 enables the host-RAM cold tier behind the
+    # device ring: when the ring is full, each ingest block overwrites
+    # the ring's LOWEST-priority-mass region (instead of blind FIFO) and
+    # the displaced region is delta+deflate-compressed into fixed-size
+    # host segments carrying per-segment priority summaries. Capacity is
+    # in TRANSITIONS; sizing rule of thumb: the cold tier holds ~10x
+    # less bytes/transition than the ring (PERF.md "Tiered replay"), so
+    # 8-64x the ring capacity costs host RAM comparable to the ring's
+    # HBM. 0 keeps the default single-tier path bitwise untouched.
+    cold_tier_capacity: int = 0
+    # compressed cold segments decompressed + restaged (through the
+    # SAME IngestStager -> add_many path as fresh actor data) per idle
+    # refill tick, highest priority mass first; 0 disables recall while
+    # still capturing evictions
+    cold_tier_refill: int = 1
+    # zlib level for cold segments (1 = speed, the wire codec's choice)
+    cold_tier_compress_level: int = 1
+
+    def __post_init__(self) -> None:
+        if self.cold_tier_capacity < 0:
+            raise ValueError(
+                f"replay.cold_tier_capacity must be >= 0 "
+                f"(got {self.cold_tier_capacity}); 0 disables the tier")
+        if self.cold_tier_capacity > 0:
+            # guided error at CONFIG time, not mid-eviction: the cold
+            # tier cannot run without the delta+deflate building blocks
+            # (a stale/missing native .so is fine — comm/native.py
+            # degrades to the bit-identical numpy fallback, and
+            # ColdStore logs a one-liner saying so)
+            from ape_x_dqn_tpu.replay.cold_store import codec_status
+            ok, detail = codec_status()
+            if not ok:
+                raise ValueError(
+                    f"replay.cold_tier_capacity={self.cold_tier_capacity} "
+                    f"needs the delta+deflate codec, which failed to "
+                    f"import: {detail}. Fix the install (ape_x_dqn_tpu."
+                    f"comm.native must be importable — no compiler or "
+                    f".so is required, the numpy fallback is "
+                    f"bit-identical) or set replay.cold_tier_capacity=0 "
+                    f"to run single-tier.")
 
 
 @dataclass(frozen=True)

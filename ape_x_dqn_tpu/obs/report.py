@@ -174,6 +174,24 @@ INSTRUMENTS = {
     "learn_shard_td_mean_max": {"kind": "gauge"},
     "learn_loss": {"kind": "hist"},
     "learning_degradations": {"kind": "ctr"},
+    # tiered cold replay (replay/cold_store.py, ISSUE 11): host-RAM
+    # compressed segments behind the device ring. cold_bytes /
+    # cold_segments track resident footprint; the ratio's floor is 1.0
+    # by construction (per-leaf never-inflate guard in
+    # packing.cold_pack + the store's explicit clamp), so a reading
+    # below it means the clamp was bypassed — a codec regression, not
+    # a workload property.
+    "cold_segments": {"kind": "gauge"},
+    "cold_bytes": {"kind": "gauge"},
+    "cold_compression_ratio": {
+        "kind": "gauge",
+        "warn": ("value_min", 1.0,
+                 "cold compression ratio below 1.0 should be "
+                 "impossible (never-inflate guard stores raw leaves) — "
+                 "a reading here means the cold codec is inflating "
+                 "data and its guard is broken")},
+    "cold_evictions": {"kind": "ctr"},
+    "cold_recalls": {"kind": "ctr"},
 }
 
 # healthy ranges, derived view kept under its historical name (the

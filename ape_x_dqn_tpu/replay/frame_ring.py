@@ -63,7 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.ops import sum_tree
-from ape_x_dqn_tpu.replay.packing import dus_rows, frame_mode, pad128
+from ape_x_dqn_tpu.replay.packing import (dus_rows, frame_mode, pad128,
+                                          ring_write_size)
 from ape_x_dqn_tpu.replay.prioritized import (PrioritizedReplay,
                                               ReplayState, ring_cursor,
                                               ring_finish)
@@ -243,19 +244,26 @@ class FrameRingReplay(PrioritizedReplay):
     # -- transitions (pure, jit-friendly) ----------------------------------
 
     def _write_segments(self, state: ReplayState, items: Any,
-                        td_abs: jax.Array,
-                        lead: tuple[int, ...]) -> ReplayState:
+                        td_abs: jax.Array, lead: tuple[int, ...],
+                        seg0: jax.Array | None = None) -> ReplayState:
         """Shared body of `add` (lead=()) and `add_lockstep`
         (lead=(dp,)): ONE contiguous dynamic_update_slice block of
         G*F frame rows / G*B transition slots per leading shard axis
         (in place on the donated state; a vmapped DUS would rebatch to
         a full-copy scatter — replay/packing.py), with skip-to-head
-        wrap at the segment cursor."""
+        wrap at the segment cursor. A caller-supplied seg0 (add_at,
+        single-chip) directs the write at that segment instead."""
         nl = len(lead)
         g = td_abs.shape[nl]
-        # cursor counts SEGMENTS, size counts transitions (size_scale)
-        seg0, pos1, size1 = ring_cursor(state.pos, state.size, g, self.S,
-                                        nl, size_scale=self.B)
+        if seg0 is None:
+            # cursor counts SEGMENTS, size counts transitions (size_scale)
+            seg0, pos1, size1 = ring_cursor(state.pos, state.size, g,
+                                            self.S, nl, size_scale=self.B)
+        else:
+            assert nl == 0, "directed writes are single-chip only"
+            pos1 = (seg0 + g) % self.S
+            size1 = ring_write_size(state.size, seg0 * self.B,
+                                    g * self.B, self.capacity)
         tidx = seg0 * self.B + jnp.arange(g * self.B, dtype=jnp.int32)
         rows = items["seg_frames"].astype(self.obs_dtype) \
             .reshape(*lead, g * self.F, self.frame_bytes)
@@ -296,6 +304,45 @@ class FrameRingReplay(PrioritizedReplay):
         [dp, G, B]}; td_abs: [dp, G, B]."""
         return self._write_segments(state, items, td_abs,
                                     lead=(td_abs.shape[0],))
+
+    # -- tiered cold store hooks (segment units; see PrioritizedReplay) ----
+
+    def evict_plan(self, state: ReplayState, block: int) -> jax.Array:
+        """Start SEGMENT of the minimum-priority-mass run of `block`
+        contiguous segments (eviction granularity is whole segments —
+        the transition<->frame aliasing invariant demands it)."""
+        seg_mass = state.tree[self.capacity:].reshape(self.S, self.B) \
+            .sum(axis=-1)
+        c = jnp.concatenate([jnp.zeros(1, seg_mass.dtype),
+                             jnp.cumsum(seg_mass)])
+        return jnp.argmin(c[block:] - c[:-block]).astype(jnp.int32)
+
+    def read_region(self, state: ReplayState, seg0: jax.Array,
+                    block: int) -> tuple[Any, jax.Array]:
+        """-> (staging-layout segments {"seg_frames": [g, F, H, W],
+        fields [g, B]}, stored leaf priorities [g, B]) for the `block`
+        segments at seg0 — the exact shape _write_segments consumes, so
+        a cold round trip restages bit-identically."""
+        g = block
+        st = state.storage
+        rows = jax.lax.dynamic_slice_in_dim(st["frames"], seg0 * self.F,
+                                            g * self.F)
+        items = {"seg_frames": rows[:, :self.frame_bytes].reshape(
+            g, self.F, self.h, self.w)}
+        for k in ("action", "reward", "discount", "next_off"):
+            items[k] = jax.lax.dynamic_slice_in_dim(
+                st[k], seg0 * self.B, g * self.B).reshape(g, self.B)
+        pri = jax.lax.dynamic_slice_in_dim(
+            state.tree, self.capacity + seg0 * self.B,
+            g * self.B).reshape(g, self.B)
+        return items, pri
+
+    def add_at(self, state: ReplayState, items: Any, td_abs: jax.Array,
+               seg0: jax.Array) -> ReplayState:
+        """Directed segment add: overwrite the G segments at seg0 (an
+        evict_plan result) instead of the FIFO segment cursor."""
+        return self._write_segments(state, items, td_abs, lead=(),
+                                    seg0=seg0)
 
     def _gather(self, state: ReplayState, idx: jax.Array) -> dict:
         """Reconstruct flat transitions {obs, action, reward, next_obs,

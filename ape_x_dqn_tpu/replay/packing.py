@@ -210,6 +210,106 @@ class PixelPacker:
         return jax.tree.unflatten(self._treedef, out)
 
 
+# -- cold-segment serialization (replay/cold_store.py) ----------------------
+#
+# A cold segment is one eviction region — `n` staging units of the item
+# spec plus their stored priorities — flattened to ONE host byte string:
+# per leaf, delta-XOR (uint8 pixel leaves, reusing the wire codec's
+# kernels in comm/native.py) + zlib deflate, framed with pack_records.
+# A 1-byte mode prefix per leaf records what was applied, with a
+# per-leaf never-inflate guard: if deflate would grow a leaf, its raw
+# bytes are stored instead (mode 0), so a segment's payload can exceed
+# its raw bytes only by the constant framing overhead (9 bytes/leaf).
+# Round trips are bitwise exact in every mode (XOR and deflate both
+# are; tests/test_cold_store.py pins it on both storage layouts).
+
+_COLD_RAW = 0        # leaf bytes verbatim
+_COLD_DEFLATE = 1    # zlib only
+_COLD_DELTA = 2      # XOR-delta rows, then zlib
+
+
+def cold_plan(item_spec: Any, ptail: tuple = ()) -> list[tuple]:
+    """Per-leaf serialization plan for one staging unit: [(key, shape,
+    dtype, delta_rows)]. delta_rows is the per-unit leading axis the
+    XOR-delta transform rows over (frames of a segment / image rows of
+    a stacked obs) or 0 for non-delta leaves. "priorities" (trailing
+    shape `ptail`, f32) is appended — it rides every cold segment so a
+    recall can restage with its eviction-time priority mass."""
+    plan = []
+    entries = [(k, tuple(s.shape), np.dtype(s.dtype))
+               for k, s in item_spec.items()]
+    entries.append(("priorities", tuple(ptail), np.dtype(np.float32)))
+    for key, shape, dtype in entries:
+        unit_bytes = math.prod(shape) * dtype.itemsize if shape \
+            else dtype.itemsize
+        delta_rows = (int(shape[0])
+                      if (dtype == np.uint8 and len(shape) >= 2
+                          and unit_bytes >= 4096) else 0)
+        plan.append((key, shape, dtype, delta_rows))
+    return plan
+
+
+def cold_pack(items: dict, plan: list[tuple],
+              level: int = 1) -> tuple[bytes, int]:
+    """Serialize {key: [n, *shape] host arrays} -> (payload, raw_bytes)
+    following `plan`. Pure host work (numpy + zlib + the comm/native.py
+    delta kernels or their bit-identical numpy fallback)."""
+    import zlib
+
+    from ape_x_dqn_tpu.comm.native import delta_encode, pack_records
+
+    chunks = []
+    raw_total = 0
+    for key, shape, dtype, delta_rows in plan:
+        a = np.ascontiguousarray(np.asarray(items[key], dtype=dtype))
+        raw_total += a.nbytes
+        if delta_rows:
+            n = a.shape[0]
+            body = zlib.compress(
+                delta_encode(a.reshape(n * delta_rows, -1)), level)
+            mode = _COLD_DELTA
+        else:
+            body = zlib.compress(a.tobytes(), level)
+            mode = _COLD_DEFLATE
+        if len(body) >= a.nbytes:  # never-inflate guard (per leaf)
+            body, mode = a.tobytes(), _COLD_RAW
+        chunks.append(bytes([mode]) + body)
+    return pack_records(chunks), raw_total
+
+
+def cold_unpack(payload: bytes, plan: list[tuple], n: int) -> dict:
+    """Inverse of cold_pack: payload -> {key: [n, *shape] arrays},
+    bitwise equal to what went in. Returned arrays may be read-only
+    views over decompressed bytes (the restage path only reads)."""
+    import zlib
+
+    from ape_x_dqn_tpu.comm.native import (delta_undo_inplace,
+                                           unpack_records)
+
+    recs = unpack_records(payload, max_records=len(plan) + 1)
+    if len(recs) != len(plan):
+        raise ValueError(
+            f"cold segment holds {len(recs)} leaves, plan expects "
+            f"{len(plan)} — segment written under a different item spec")
+    out = {}
+    for (key, shape, dtype, delta_rows), rec in zip(plan, recs):
+        mode, body = rec[0], rec[1:]
+        if mode == _COLD_RAW:
+            raw: Any = body
+        elif mode == _COLD_DEFLATE:
+            raw = zlib.decompress(body)
+        elif mode == _COLD_DELTA:
+            rows = np.frombuffer(zlib.decompress(body), np.uint8) \
+                .reshape(n * delta_rows, -1).copy()
+            delta_undo_inplace(rows)
+            raw = rows
+        else:
+            raise ValueError(f"unknown cold leaf mode {mode}")
+        buf = raw.tobytes() if isinstance(raw, np.ndarray) else raw
+        out[key] = np.frombuffer(buf, dtype=dtype).reshape((n, *shape))
+    return out
+
+
 def make_packer(item_spec: Any) -> tuple[PixelPacker | None, Any]:
     """-> (packer or None, storage spec): the one place the packing
     decision is made, shared by every replay class so storage layout

@@ -118,16 +118,24 @@ class PrioritizedReplay:
     # -- transitions (all pure, jit-friendly) ------------------------------
 
     def _write_block(self, state: ReplayState, items: Any,
-                     td_abs: jax.Array,
-                     lead: tuple[int, ...]) -> ReplayState:
+                     td_abs: jax.Array, lead: tuple[int, ...],
+                     start: jax.Array | None = None) -> ReplayState:
         """Shared body of `add` (lead=()) and `add_lockstep`
         (lead=(dp,)): one in-place dynamic_update_slice block per leaf
         with skip-to-head wrap; only the small per-shard sum-trees go
         through vmap on the lockstep path."""
         nl = len(lead)
         b = td_abs.shape[nl]
-        start, pos1, size1 = ring_cursor(state.pos, state.size, b,
-                                         self.capacity, nl)
+        if start is None:
+            start, pos1, size1 = ring_cursor(state.pos, state.size, b,
+                                             self.capacity, nl)
+        else:
+            # directed write (add_at, single-chip): overwrite the caller-
+            # chosen region; the cursor resumes after it so subsequent
+            # FIFO adds don't immediately clobber what was just written
+            assert nl == 0, "directed writes are single-chip only"
+            pos1 = (start + b) % self.capacity
+            size1 = ring_write_size(state.size, start, b, self.capacity)
         idx = start + jnp.arange(b, dtype=jnp.int32)  # same every shard
         if self._packer is not None:
             items = self._packer.encode(items)
@@ -168,6 +176,47 @@ class PrioritizedReplay:
         """
         return self._write_block(state, items, td_abs,
                                  lead=(td_abs.shape[0],))
+
+    # -- tiered cold store hooks (replay/cold_store.py; single-chip) -------
+    #
+    # Three pure functions the driver composes into its eviction cycle
+    # when ReplayConfig.cold_tier_capacity > 0: pick the ring's
+    # lowest-priority-mass contiguous region (evict_plan), read it out
+    # in STAGING layout (read_region, fetched to host and handed to
+    # ColdStore.put), then overwrite exactly that region with the fresh
+    # staged block (add_at). With the tier off none of these run and
+    # `add` keeps its blind skip-to-head FIFO — bitwise-identical
+    # default path, pinned by tests/test_cold_store.py.
+
+    def evict_plan(self, state: ReplayState, block: int) -> jax.Array:
+        """Start slot of the minimum-priority-mass contiguous
+        `block`-slot window (windowed leaf-mass sum via cumsum; the
+        argmin range [0, capacity-block] never wraps, so the start is
+        always a legal dynamic-slice origin)."""
+        leaves = state.tree[self.capacity:]
+        c = jnp.concatenate([jnp.zeros(1, leaves.dtype),
+                             jnp.cumsum(leaves)])
+        return jnp.argmin(c[block:] - c[:-block]).astype(jnp.int32)
+
+    def read_region(self, state: ReplayState, start: jax.Array,
+                    block: int) -> tuple[Any, jax.Array]:
+        """-> (items [block, ...] in staging layout, stored leaf
+        priorities [block]) for the region about to be overwritten."""
+        items = jax.tree.map(
+            lambda buf: jax.lax.dynamic_slice_in_dim(buf, start, block),
+            state.storage)
+        if self._packer is not None:
+            items = self._packer.decode(items)
+        pri = jax.lax.dynamic_slice_in_dim(
+            state.tree, self.capacity + start, block)
+        return items, pri
+
+    def add_at(self, state: ReplayState, items: Any, td_abs: jax.Array,
+               start: jax.Array) -> ReplayState:
+        """Directed `add`: overwrite the `B` slots at `start` (an
+        evict_plan result) instead of the FIFO cursor position."""
+        return self._write_block(state, items, td_abs, lead=(),
+                                 start=start)
 
     def sample_items(self, state: ReplayState, rng: jax.Array, batch: int
                      ) -> tuple[Any, jax.Array, jax.Array]:

@@ -40,6 +40,7 @@ from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
 from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
+from ape_x_dqn_tpu.replay.cold_store import ColdStore
 from ape_x_dqn_tpu.replay.frame_ring import FrameRingReplay
 from ape_x_dqn_tpu.replay.prioritized import PrioritizedReplay
 from ape_x_dqn_tpu.runtime.family import (
@@ -285,6 +286,46 @@ class ApexDriver:
                 coalesce=getattr(cfg.replay, "ingest_coalesce", 4),
                 buffers=getattr(cfg.replay, "stage_buffers", 2),
                 ship=self._ship_staged)
+        # tiered cold store (replay/cold_store.py; ROADMAP item 3):
+        # host-RAM compressed segments behind the ring, default OFF.
+        # With the tier on and the ring full, every ship evicts the
+        # ring's lowest-priority-mass region to the cold store and the
+        # idle refill tick recalls the highest-mass cold segments back
+        # through the stager. All cold counters are transition-
+        # denominated and touched by the ingest thread only; the pinned
+        # closure `evicted == stored + dropped` is tested in
+        # tests/test_ingest.py (door outcomes — displacements of
+        # already-stored segments are the store's own counter).
+        self._cold: ColdStore | None = None
+        self._cold_evicted = 0   # ingest thread only
+        self._cold_stored = 0    # ingest thread only
+        self._cold_dropped = 0   # ingest thread only
+        self._cold_recalled = 0  # ingest thread only
+        cold_cap = getattr(cfg.replay, "cold_tier_capacity", 0)
+        if cold_cap > 0:
+            if self.is_dist:
+                raise NotImplementedError(
+                    "replay.cold_tier_capacity > 0 is single-chip only "
+                    "for now — the dp-sharded lockstep ring has no "
+                    "directed per-shard eviction write; run dp=tp=1 or "
+                    "set cold_tier_capacity=0")
+            if self.family != "dqn" or not getattr(
+                    self.replay, "has_priorities", False):
+                raise NotImplementedError(
+                    "the cold tier needs prioritized flat/frame-ring "
+                    "DQN replay (priority-mass eviction has no meaning "
+                    "without a sum tree); set cold_tier_capacity=0 for "
+                    f"family={self.family!r}, kind={cfg.replay.kind!r}")
+            if self._stager is None:
+                raise ValueError(
+                    "the cold tier refills through the zero-copy ingest "
+                    "stager — replay.ingest_zero_copy=False and "
+                    "cold_tier_capacity > 0 are incompatible")
+            self._cold = ColdStore(
+                item_spec, cold_cap, unit_items=self._unit_items,
+                ptail=ptail,
+                compress_level=getattr(cfg.replay,
+                                       "cold_tier_compress_level", 1))
         # profiler capture state: False = armed, True = tracing,
         # None = finished/disabled (single capture per run)
         self._profiling: bool | None = False if cfg.profile_dir else None
@@ -646,6 +687,9 @@ class ApexDriver:
                     # a slow actor stream
                     if self._stager is not None:
                         self._stager.drain()
+                    # idle bandwidth goes to cold recalls: high-mass
+                    # cold segments restage through the same stager
+                    self._cold_refill_tick()
                     continue
                 n = batch_rows(batch)
                 self._ingest_one(batch, n)
@@ -713,6 +757,9 @@ class ApexDriver:
         g == 1 uses the warmed single-block `add` graph (idle drains);
         g == coalesce uses the warmed `add_many` — exactly two graphs."""
         count = g * self.dp * self._stage_chunk
+        if self._cold is not None and self._replay_filled >= self.capacity:
+            # ring full + tier on: every ship becomes an eviction swap
+            return self._ship_staged_cold(views, g)
         if self.is_dist:
             shape = (g, self.dp, self._stage_chunk) if g > 1 \
                 else (self.dp, self._stage_chunk)
@@ -765,6 +812,78 @@ class ApexDriver:
                 self.capacity)
         self.obs.gauge("ingest_coalesce_width", g)
         return handles
+
+    def _ship_staged_cold(self, views: dict, g: int) -> list:
+        """Eviction-swap ship (cold tier on, ring full, single-chip):
+        per staged block, the jitted evict_region picks the ring's
+        lowest-priority-mass region and reads it out in staging layout;
+        the region is fetched to host (a sync — the directed add_at
+        aliases those buffers in place a line later), compressed into
+        the ColdStore, and the fresh block overwrites exactly that
+        region via add_at. Blocks are swapped one at a time (not the
+        coalesced add_many) because each one's eviction plan must see
+        the tree the previous swap produced."""
+        chunk = self._stage_chunk
+        handles = []
+        for j in range(g):
+            block = {k: v[j * chunk:(j + 1) * chunk]
+                     for k, v in views.items()}
+            staged = {k: jax.device_put(v) for k, v in block.items()}
+            pris = staged.pop("priorities")
+            with self._state_lock:
+                with self.obs.span("replay.evict", units=chunk):
+                    start, ev_items, ev_pri = self.learner.evict_region(
+                        self.state, chunk)
+                    # host fetch BEFORE the donated overwrite deletes
+                    # the region's device buffers
+                    ev_host = {k: np.asarray(v)
+                               for k, v in ev_items.items()}
+                    ev_pri = np.asarray(ev_pri)
+                    self.state = self.learner.add_at(self.state, staged,
+                                                     pris, start)
+            live = int((ev_pri > 0).sum())
+            self._cold_evicted += live
+            if self._cold.put(ev_host, ev_pri, live) == "stored":
+                self._cold_stored += live
+            else:
+                self._cold_dropped += live
+            self.obs.count("cold_evictions")
+            handles += list(staged.values()) + [pris]
+        self.ingest_rows.add(g * chunk * self._unit_items)
+        # _replay_filled stays at capacity: eviction swaps slots 1:1
+        self.obs.gauge("ingest_coalesce_width", g)
+        self._emit_cold_gauges()
+        return handles
+
+    def _cold_refill_tick(self) -> None:
+        """Idle-time recall (ingest thread, queue dry): pop up to
+        cold_tier_refill of the highest-priority-mass cold segments,
+        invert their stored sum-tree leaf values back to |td| (the add
+        path re-applies (|td|+eps)^alpha at write time), and restage
+        them through the normal stager so recalled data rides the same
+        one-copy staging->add path as fresh actor experience."""
+        if self._cold is None or not len(self._cold):
+            return
+        k = getattr(self.cfg.replay, "cold_tier_refill", 1)
+        if k <= 0:
+            return
+        alpha, eps = self.replay.alpha, self.replay.eps
+        for batch in self._cold.recall(k):
+            pri = np.asarray(batch["priorities"], np.float32)
+            td = np.maximum(pri ** (1.0 / alpha) - eps, 0.0) \
+                .astype(np.float32)
+            batch = dict(batch, priorities=td)
+            self._stager.put(batch)
+            self._cold_recalled += int((pri > 0).sum())
+            self.obs.count("cold_recalls")
+        self._emit_cold_gauges()
+
+    def _emit_cold_gauges(self) -> None:
+        cold = self._cold
+        self.obs.gauge("cold_segments", float(len(cold)))
+        self.obs.gauge("cold_bytes", float(cold.bytes_compressed))
+        self.obs.gauge("cold_compression_ratio",
+                       cold.compression_ratio())
 
     def _add_block(self, take: dict, count: int) -> None:
         """count is in staging units; priorities reshape like items (they
@@ -917,6 +1036,16 @@ class ApexDriver:
         c_step = cls.train_step.lower(learner, self.state).compile()
         self.obs.log_compiled("add", c_add)
         self.obs.log_compiled("train_step", c_step)
+        if self._cold is not None:
+            # the eviction-swap path's two graphs (single-chip shapes):
+            # a first-dispatch compile here would otherwise hold
+            # _state_lock mid-ship exactly when the ring first fills
+            c_ev = cls.evict_region.lower(
+                learner, self.state, self._stage_chunk).compile()
+            c_addat = cls.add_at.lower(learner, self.state, example,
+                                       pris, jnp.int32(0)).compile()
+            self.obs.log_compiled("evict_region", c_ev)
+            self.obs.log_compiled("add_at", c_addat)
         if self._stager is not None and self._stager.coalesce > 1:
             # coalesced ingest groups [g, ...block shape] — the other
             # add graph the zero-copy stager dispatches (full buffers)
@@ -1373,6 +1502,20 @@ class ApexDriver:
             "loop_errors": list(self.loop_errors),
             "eval": self.last_eval,
         }
+        if self._cold is not None:
+            # transition-denominated door closure:
+            # evicted == stored + dropped (tests/test_ingest.py)
+            out["cold_tier"] = {
+                "evicted": self._cold_evicted,
+                "stored": self._cold_stored,
+                "dropped": self._cold_dropped,
+                "recalled": self._cold_recalled,
+                "displaced_segments": self._cold.displaced,
+                "segments": len(self._cold),
+                "transitions": self._cold.transitions,
+                "bytes": self._cold.bytes_compressed,
+                "compression_ratio": self._cold.compression_ratio(),
+            }
         if self.is_dist:
             # teardown-time per-shard fill/mass: the state is quiescent
             # (all loops joined above), so the device fetch is safe

@@ -358,6 +358,27 @@ class SingleChipLearner:
                 rs, jax.tree.map(lambda x, j=j: x[j], items), td_abs[j])
         return state._replace(replay=rs)
 
+    # -- tiered cold store endpoints (runtime/driver.py eviction cycle) ----
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def evict_region(self, state: TrainState, block: int):
+        """-> (start, staging-layout items, stored leaf priorities) of
+        the ring's lowest-priority-mass `block`-unit region. NOT
+        donated: the driver fetches the result to host (ColdStore.put)
+        before add_at overwrites the region in place."""
+        start = self.replay.evict_plan(state.replay, block)
+        items, pri = self.replay.read_region(state.replay, start, block)
+        return start, items, pri
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add_at(self, state: TrainState, items: Any, td_abs: jax.Array,
+               start: jax.Array) -> TrainState:
+        """Directed ingest add: overwrite the evict_region start instead
+        of the FIFO cursor (cold tier on + ring full; the default path
+        never calls this)."""
+        return state._replace(
+            replay=self.replay.add_at(state.replay, items, td_abs, start))
+
     def publish_params(self, state: TrainState) -> Any:
         """Independent param copy for the inference server — the train/add
         jits donate the TrainState, so aliased buffers would be deleted

@@ -1770,6 +1770,311 @@ def bench_multichip(args) -> None:
     raise SystemExit(rc)
 
 
+# -- tiered replay lane (replay/cold_store.py; ROADMAP item 3) ---------------
+
+
+def _tiered_artifact_path(smoke: bool) -> str:
+    """Artifact of record for the tiered-replay lane. Same smoke/full
+    split as the main bench: a CI smoke run only ever gates against a
+    smoke baseline."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "TIERED_SMOKE.json" if smoke
+                        else "TIERED_LATEST.json")
+
+
+def _load_tiered_baseline(smoke: bool, storage: str, capacity: int
+                          ) -> tuple[str | None, dict | None]:
+    """Newest COMPARABLE tiered artifact: same smoke class, same
+    storage layout, same ring capacity. The on-arm grad-steps/s bakes
+    in the eviction-block geometry those fix — a cross-shape gate
+    would fire on a shape change, not a regression."""
+    path = _tiered_artifact_path(smoke)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    if not (isinstance(doc, dict) and "metric" in doc
+            and "value" in doc):
+        return None, None
+    if (doc.get("storage") != storage
+            or doc.get("capacity") != capacity):
+        log(f"tiered gate: {os.path.basename(path)} is "
+            f"{doc.get('storage')}@{doc.get('capacity')}, this run is "
+            f"{storage}@{capacity} — not comparable, skipped")
+        return None, None
+    return path, doc
+
+
+def _tiered_seg_chunk(replay, spec, g: int, rng) -> tuple[dict, object]:
+    """Delta-compressible frame segments for the tiered lane:
+    consecutive frames share a base image with sparse per-frame noise,
+    like real emulator play. Pure-random frames (what _seg_chunk
+    generates) are incompressible by construction and would make the
+    lane's bytes/transition bar unmeetable regardless of codec
+    quality — the cold pack exists to exploit frame redundancy, so the
+    synthetic stream has to carry some."""
+    b, f = replay.B, replay.F
+    h, w = spec.obs_shape[:2]
+    base = rng.integers(0, 255, (h, w)).astype(np.uint8)
+    frames = np.broadcast_to(base, (g, f, h, w)).copy()
+    noise = frames[:, :, ::7, ::11]
+    frames[:, :, ::7, ::11] = rng.integers(0, 255, noise.shape)
+    items = {
+        "seg_frames": np.ascontiguousarray(frames),
+        "action": np.ascontiguousarray(
+            rng.integers(0, spec.num_actions, (g, b)).astype(np.int32)),
+        "reward": np.ascontiguousarray(
+            rng.normal(size=(g, b)).astype(np.float32)),
+        "discount": np.full((g, b), 0.99**3, np.float32),
+        "next_off": np.full((g, b), 3, np.int32),
+    }
+    pris = np.ascontiguousarray(
+        rng.uniform(0.1, 2.0, (g, b)).astype(np.float32))
+    return items, pris
+
+
+def _tiered_flat_chunk(spec, chunk: int, rng) -> tuple[dict, object]:
+    """Flat-layout analog of _tiered_seg_chunk. cold_plan's delta rows
+    for a stacked obs are IMAGE rows, so compressibility needs
+    row-coherent images: a row-constant base plus sparse noise."""
+    h = spec.obs_shape[0]
+    base = np.broadcast_to(
+        rng.integers(0, 255, spec.obs_shape[1:]).astype(np.uint8),
+        spec.obs_shape)
+
+    def obs_block():
+        o = np.broadcast_to(base, (chunk, *spec.obs_shape)).copy()
+        noise = o[:, ::7, ::11]
+        o[:, ::7, ::11] = rng.integers(0, 255, noise.shape)
+        return np.ascontiguousarray(o)
+
+    items = {
+        "obs": obs_block(),
+        "action": np.ascontiguousarray(
+            rng.integers(0, spec.num_actions, chunk).astype(np.int32)),
+        "reward": np.ascontiguousarray(
+            rng.normal(size=chunk).astype(np.float32)),
+        "next_obs": obs_block(),
+        "discount": np.full(chunk, 0.99**3, np.float32),
+    }
+    pris = np.ascontiguousarray(
+        rng.uniform(0.1, 2.0, chunk).astype(np.float32))
+    return items, pris
+
+
+def bench_tiered_ab(args) -> None:
+    """Tiered-replay A/B (ROADMAP item 3): grad-steps/s with every
+    ingest block riding the ring-full eviction swap — jitted
+    evict_plan/read_region picks and reads the ring's lowest-priority-
+    mass region, the region is fetched to host and compressed into the
+    ColdStore, and the fresh block overwrites it via the directed
+    add_at — vs the plain FIFO add path at identical shapes. Then a
+    capacity soak (the cold tier must hold --tiered-ring-mult x the
+    ring's transitions at under 1/8 of its bytes/transition) and a
+    recall decompress-throughput measurement.
+
+    This is the driver's _ship_staged_cold/_cold_refill_tick data path
+    run open-loop at the learner API, so the A/B isolates the swap
+    cost itself (no actor fleet, no stager jitter). Artifact:
+    TIERED_LATEST.json (TIERED_SMOKE.json under --smoke); --perf-gate
+    gates gsps_on against the newest comparable artifact with the
+    anti-ratchet rule (a failing run never becomes the baseline)."""
+    from ape_x_dqn_tpu.replay.cold_store import ColdStore, codec_status
+    from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
+    from ape_x_dqn_tpu.runtime.learner import transition_item_spec
+
+    capacity, batch, storage = args.capacity, args.batch_size, args.storage
+    net, learner, state, spec = build_learner(capacity, batch, storage,
+                                              args.sample_chunk)
+    replay = learner.replay
+    rng = np.random.default_rng(7)
+    block_tr = max(min(args.tiered_block, capacity // 4), 1)
+    if storage == "frame_ring":
+        block_units = max(block_tr // replay.B, 1)
+        block_tr = block_units * replay.B
+        unit_items = replay.B
+        item_spec = frame_segment_spec(replay.B, replay.n,
+                                       spec.obs_shape, spec.obs_dtype)
+        ptail = (replay.B,)
+        host_items, host_pris = _tiered_seg_chunk(replay, spec,
+                                                  block_units, rng)
+    else:
+        block_units = block_tr
+        unit_items = 1
+        item_spec = transition_item_spec(spec.obs_shape, spec.obs_dtype)
+        ptail = ()
+        host_items, host_pris = _tiered_flat_chunk(spec, block_tr, rng)
+    cold_cap = args.tiered_cold_capacity or 16 * capacity
+    cold = ColdStore(item_spec, cold_cap, unit_items=unit_items,
+                     ptail=ptail, compress_level=1)
+    log(f"tiered: codec {codec_status()[1]}, ring {capacity} "
+        f"transitions ({storage}), cold capacity {cold_cap}, block "
+        f"{block_tr} transitions ({block_units} staging units)")
+
+    def put_block():
+        # fresh h2d per dispatch in BOTH arms — real ingest always
+        # lands from host staging memory, so the link cost is common
+        # mode and the A/B isolates the swap machinery
+        staged = {k: jax.device_put(v) for k, v in host_items.items()}
+        return staged, jax.device_put(host_pris)
+
+    # prefill the ring FULL through the real add jit (the tier only
+    # engages on a full ring)
+    for _ in range(max(capacity // block_tr, 1)):
+        staged, pris = put_block()
+        state = learner.add(state, staged, pris)
+    jax.block_until_ready(state.replay.tree)
+
+    # warm every graph either arm dispatches
+    t0 = time.monotonic()
+    state, m = learner.train_many(state, args.steps_per_dispatch)
+    jax.block_until_ready(m["loss"])
+    start, _ev_items, ev_pri = learner.evict_region(state, block_units)
+    np.asarray(ev_pri)
+    staged, pris = put_block()
+    state = learner.add_at(state, staged, pris, start)
+    jax.block_until_ready(state.replay.tree)
+    log(f"tiered compile+warmup: {time.monotonic() - t0:.1f}s")
+
+    def swap_once(state, store):
+        """One eviction swap — the _ship_staged_cold body, open-loop
+        (host fetch BEFORE the donated add_at, same as the driver)."""
+        staged, pris = put_block()
+        start, ev_items, ev_pri = learner.evict_region(state,
+                                                       block_units)
+        ev_host = {k: np.asarray(v) for k, v in ev_items.items()}
+        ev_pri = np.asarray(ev_pri)
+        state = learner.add_at(state, staged, pris, start)
+        if store is not None:
+            live = int((ev_pri > 0).sum())
+            store.put(ev_host, ev_pri, live)
+        return state
+
+    # A/B: per dispatch, one ingest block + one train_many. OFF = the
+    # plain FIFO add; ON = the full eviction swap.
+    steps, dispatches = args.steps_per_dispatch, args.dispatches
+    off_rates, on_rates = [], []
+    for _ in range(args.repeats):
+        t0 = time.monotonic()
+        for _ in range(dispatches):
+            staged, pris = put_block()
+            state = learner.add(state, staged, pris)
+            state, m = learner.train_many(state, steps)
+        jax.block_until_ready(m["loss"])
+        off_rates.append(steps * dispatches / (time.monotonic() - t0))
+        t0 = time.monotonic()
+        for _ in range(dispatches):
+            state = swap_once(state, cold)
+            state, m = learner.train_many(state, steps)
+        jax.block_until_ready(m["loss"])
+        on_rates.append(steps * dispatches / (time.monotonic() - t0))
+    gsps_off = float(np.median(off_rates))
+    gsps_on = float(np.median(on_rates))
+    on_off = gsps_on / gsps_off if gsps_off else 0.0
+    log(f"tiered A/B: off {spread(off_rates)} vs on {spread(on_rates)} "
+        f"grad-steps/s (on/off {on_off:.3f})")
+
+    # capacity soak: keep swapping until the cold tier holds the target
+    # ring multiple of LIVE transitions; the swap bound is the honest
+    # failure mode if the door starts dropping
+    target = int(args.tiered_ring_mult * capacity)
+    max_swaps = 4 * (target // block_tr + 1)
+    swaps = 0
+    t0 = time.monotonic()
+    while cold.transitions < target and swaps < max_swaps:
+        state = swap_once(state, cold)
+        swaps += 1
+    jax.block_until_ready(state.replay.tree)
+    soak_s = time.monotonic() - t0
+    evict_tr_per_s = swaps * block_tr / soak_s if soak_s else 0.0
+    log(f"tiered soak: {swaps} swaps -> {cold.transitions} live cold "
+        f"transitions in {soak_s:.1f}s ({evict_tr_per_s:,.0f} "
+        f"transitions/s through the evict+compress path)")
+
+    # stats snapshot BEFORE the recall measurement drains segments
+    cold_tr = cold.transitions
+    n_segments = len(cold)
+    ratio = cold.compression_ratio()
+    cold_bpt = (cold.bytes_compressed / cold_tr) if cold_tr \
+        else float("inf")
+    # the ring's resident device bytes per transition (storage + sum
+    # tree + cursors — everything HBM pays for the hot set)
+    ring_bytes = sum(getattr(leaf, "nbytes", 0)
+                     for leaf in jax.tree.leaves(state.replay))
+    ring_bpt = ring_bytes / capacity
+    bytes_ratio = cold_bpt / ring_bpt if ring_bpt else float("inf")
+    cold_ring_ratio = cold_tr / capacity
+
+    rec_segments = min(n_segments, 32)
+    rec_items = 0
+    t0 = time.monotonic()
+    for batch_out in cold.recall(rec_segments):
+        rec_items += int(np.asarray(batch_out["priorities"]).size)
+    rec_s = time.monotonic() - t0
+    recall_items_per_s = rec_items / rec_s if rec_s else 0.0
+    log(f"tiered recall: {rec_segments} segments, {rec_items} "
+        f"transitions in {rec_s:.2f}s ({recall_items_per_s:,.0f} "
+        f"items/s decompressed)")
+
+    ok = (cold_ring_ratio >= args.tiered_ring_mult
+          and bytes_ratio < 0.125)
+    result = {
+        "metric": "tiered_grad_steps_per_s_on",
+        "value": float(f"{gsps_on:.4g}"),
+        "unit": "steps/s",
+        "ok": ok,
+        "smoke": bool(args.smoke),
+        "storage": storage,
+        "capacity": capacity,
+        "cold_capacity": cold_cap,
+        "batch": batch,
+        "block_transitions": block_tr,
+        "codec": codec_status()[1],
+        "grad_steps_per_s_off": spread(off_rates),
+        "grad_steps_per_s_on": spread(on_rates),
+        "on_off_frac": round(on_off, 4),
+        "within_5pct": bool(on_off >= 0.95),
+        "cold_transitions": cold_tr,
+        "cold_segments": n_segments,
+        "cold_ring_ratio": round(cold_ring_ratio, 3),
+        "cold_bytes_per_transition": round(cold_bpt, 2),
+        "ring_bytes_per_transition": round(ring_bpt, 2),
+        "bytes_ratio": round(bytes_ratio, 5),
+        "cold_compression_ratio": round(ratio, 2),
+        "evict_transitions_per_s": round(evict_tr_per_s, 1),
+        "recall_items_per_s": round(recall_items_per_s, 1),
+        "door": {"stored": cold.stored, "dropped": cold.dropped,
+                 "displaced": cold.displaced,
+                 "recalled": cold.recalled},
+    }
+    line = json.dumps(result)
+    gated = getattr(args, "perf_gate", False)
+    rc = 0
+    if gated:
+        args._baseline = _load_tiered_baseline(args.smoke, storage,
+                                               capacity)
+        rc = _gate_exit(result, args)
+    if not ok:
+        log(f"tiered: capacity criteria NOT met (ring multiple "
+            f"{cold_ring_ratio:.2f} vs >= {args.tiered_ring_mult}, "
+            f"bytes ratio {bytes_ratio:.4f} vs < 0.125)")
+        rc = rc or 1
+    if rc == 0 or not gated:
+        if ok:
+            path = _tiered_artifact_path(args.smoke)
+            try:
+                with open(path, "w") as fh:
+                    fh.write(line + "\n")
+            except OSError as e:
+                log(f"could not write tiered artifact {path}: {e!r}")
+    else:
+        log("tiered perf-gate: artifact of record NOT updated by this "
+            "failing run")
+    print(line, flush=True)
+    raise SystemExit(rc)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--capacity", type=int, default=1 << 20,
@@ -1872,6 +2177,32 @@ def main() -> None:
                    "scaling'). Accepts '1,2,4,8' or 'dp=1,2,4,8'")
     p.add_argument("--multichip-child", type=int, default=None,
                    metavar="DP", help=argparse.SUPPRESS)
+    p.add_argument("--tiered-ab", action="store_true",
+                   help="run the tiered-replay A/B INSTEAD of the main "
+                   "bench (replay/cold_store.py, ROADMAP item 3): "
+                   "grad-steps/s with every ingest block riding the "
+                   "ring-full eviction swap (lowest-priority-mass "
+                   "region -> delta+deflate host-RAM cold store, fresh "
+                   "block in via the directed add_at) vs the plain "
+                   "FIFO add path, plus a capacity soak (the cold "
+                   "tier must hold --tiered-ring-mult x the ring's "
+                   "transitions at < 1/8 of its bytes/transition) and "
+                   "recall decompress throughput. Writes "
+                   "TIERED_LATEST.json (TIERED_SMOKE.json under "
+                   "--smoke; PERF.md 'Tiered replay')")
+    p.add_argument("--tiered-cold-capacity", type=int, default=0,
+                   help="cold-tier capacity in transitions for the "
+                   "tiered lane (0 = 16x --capacity, enough headroom "
+                   "for the 8x soak target before the admission door "
+                   "engages)")
+    p.add_argument("--tiered-block", type=int, default=1024,
+                   help="transitions per eviction swap block in the "
+                   "tiered lane (rounded down to whole frame segments "
+                   "under --storage frame_ring; capped at capacity/4)")
+    p.add_argument("--tiered-ring-mult", type=float, default=8.0,
+                   help="capacity-soak target: the cold tier must end "
+                   "up holding this multiple of the ring's transitions "
+                   "(8 = the tiering acceptance bar)")
     p.add_argument("--learn-health", action="store_true",
                    help="run the learning-health smoke lane INSTEAD of "
                    "the main bench: short real training runs (one per "
@@ -1930,6 +2261,7 @@ def main() -> None:
         args.ab_dispatches = min(args.ab_dispatches, 2)
         args.chaos_ab_seconds = min(args.chaos_ab_seconds, 2.0)
         args.lh_frames = min(args.lh_frames, 800)
+        args.tiered_block = min(args.tiered_block, 512)
     # the baseline must be read BEFORE _emit overwrites the artifact
     args._baseline = (_load_baseline(args.smoke) if args.perf_gate
                       else (None, None))
@@ -1944,6 +2276,9 @@ def main() -> None:
         return
     if args.learn_health:
         bench_learn_health(args)
+        return
+    if args.tiered_ab:
+        bench_tiered_ab(args)
         return
     log(f"devices: {jax.devices()}")
     if args.prefetch_ab:

@@ -1,0 +1,12 @@
+"""obs-names fixture: the two ways a cold-tier PR drifts.
+
+`cold_compression_ratio` is emitted as a counter while the table lists
+a gauge (the never-inflate value_min row would look under ctr/ and
+never fire); `cold_recall_lag_s` has no row at all (a new recall-path
+signal the report would silently drop).
+"""
+
+
+def publish_cold(obs, ratio, lag_s):
+    obs.count("cold_compression_ratio", ratio)  # kind mismatch
+    obs.gauge("cold_recall_lag_s", lag_s)  # no row, no waiver

@@ -1,0 +1,17 @@
+"""obs-names fixture: the cold-tier emission shape (ISSUE 11).
+
+Mirrors runtime/driver.py's _emit_cold_gauges + the eviction/recall
+counters: every cold-tier signal carries a row in the cold report
+fixture under the kind the registry publishes it as.
+"""
+
+
+def publish_cold(obs, segments, nbytes, ratio):
+    obs.gauge("cold_segments", segments)
+    obs.gauge("cold_bytes", nbytes)
+    obs.gauge("cold_compression_ratio", ratio)
+
+
+def publish_cold_events(obs):
+    obs.count("cold_evictions")
+    obs.count("cold_recalls")

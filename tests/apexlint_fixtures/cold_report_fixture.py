@@ -1,0 +1,14 @@
+"""obs-names fixture: mini INSTRUMENTS table for the cold tier.
+
+Rows match cold_good.py's emissions; `cold_compression_ratio` is
+listed as a gauge so cold_bad.py's counter emission is a kind-mismatch
+finding.
+"""
+
+INSTRUMENTS = {
+    "cold_segments": {"kind": "gauge"},
+    "cold_bytes": {"kind": "gauge"},
+    "cold_compression_ratio": {"kind": "gauge"},
+    "cold_evictions": {"kind": "ctr"},
+    "cold_recalls": {"kind": "ctr"},
+}

@@ -88,6 +88,19 @@ if ! python bench.py --multichip dp=1,2 --smoke --perf-gate; then
     failed_files+=("bench.py --multichip dp=1,2 --smoke")
 fi
 
+# Tiered-replay smoke: the eviction-swap A/B + capacity soak
+# (replay/cold_store.py). The lane's own criteria (cold tier holds 8x
+# the ring at < 1/8 of its bytes/transition) are hard, and --perf-gate
+# anti-ratchets the on-arm grad-steps/s against the last comparable
+# (same storage/capacity/smoke class) TIERED_SMOKE.json; failing runs
+# never reseed the baseline.
+echo
+echo "=== bench.py --tiered-ab --smoke"
+if ! python bench.py --tiered-ab --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --tiered-ab --smoke")
+fi
+
 echo
 if [ "${fail}" -ne 0 ]; then
     echo "FAILED files: ${failed_files[*]}"

@@ -233,6 +233,24 @@ def test_obs_names_multichip_fixtures():
     assert len(bad.findings) == 2
 
 
+def test_obs_names_cold_fixtures():
+    """The cold-tier fixture pair (ISSUE 11): the good emitter's
+    occupancy/ratio gauges + eviction/recall counters cross-reference
+    cleanly against the mini table; the bad emitter drifts both ways
+    (the ratio emitted as a counter, an unlisted recall-lag gauge)."""
+    report = _fx("cold_report_fixture.py")
+    good = obs_names.check([_fx("cold_good.py")], report)
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = obs_names.check(
+        [_fx("cold_good.py"), _fx("cold_bad.py")], report)
+    msgs = [f.message for f in bad.findings]
+    assert any("cold_compression_ratio" in m for m in msgs)  # kind
+    assert any("cold_recall_lag_s" in m for m in msgs)  # unlisted
+    assert len(bad.findings) == 2
+
+
 def test_obs_names_kind_mismatch(tmp_path):
     emit = tmp_path / "emit.py"
     emit.write_text("def f(obs):\n    obs.gauge('x_name', 1)\n")
