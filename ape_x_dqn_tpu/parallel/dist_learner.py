@@ -98,7 +98,7 @@ class _DistLearnerBase:
         # process the slices it owns from its (identical, same-seed)
         # host copy
         def put(x, sharding):
-            x = np.asarray(x)
+            x = np.asarray(x)  # apexlint: host-sync(one-time init: host copy feeding make_array_from_callback)
             return jax.make_array_from_callback(
                 x.shape, sharding, lambda idx: x[idx])
 
@@ -472,7 +472,7 @@ class _DistLearnerBase:
 
     # -- per-shard observability -------------------------------------------
 
-    def shard_stats(self, state: DistTrainState) -> dict:
+    def shard_stats(self, state: DistTrainState) -> dict:  # apexlint: host-sync(documented off the hot loop: teardown, publish boundaries, bench epilogues)
         """Per-shard replay fill/sample statistics for the obs plane
         and the multichip bench lane (bench.py --multichip):
 
@@ -508,7 +508,7 @@ class _DistLearnerBase:
         }
 
 
-class DistDQNLearner(_DistLearnerBase):
+class DistDQNLearner(_DistLearnerBase):  # apexlint: parity(no evict_region/add_at — the dp-sharded lockstep ring cannot run the cold tier yet; directed per-shard eviction is ROADMAP item 3's open work)
     """Flat n-step double-DQN over the mesh (SURVEY.md §3.3)."""
 
     def __init__(self, net_apply: Callable, replay: PrioritizedReplay,
@@ -527,7 +527,7 @@ class DistDQNLearner(_DistLearnerBase):
             discounts=items["discount"])
 
 
-class DistSequenceLearner(_DistLearnerBase):
+class DistSequenceLearner(_DistLearnerBase):  # apexlint: parity(no evict_region/add_at — the dp-sharded lockstep ring cannot run the cold tier yet; directed per-shard eviction is ROADMAP item 3's open work)
     """R2D2 stored-state sequences over the mesh (SURVEY.md §3.4; the
     r2d2 config attests dp=4 x tp=2).
 

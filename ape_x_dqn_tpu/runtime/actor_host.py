@@ -46,7 +46,7 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
                    num_actors: int | None = None,
                    actor_offset: int = 0,
                    frames_per_actor: int | None = None,
-                   param_poll_s: float = 2.0,
+                   param_poll_s: float | None = None,
                    stop_event: threading.Event | None = None,
                    wait_for_params_s: float = 60.0,
                    peer_id: str | None = None,
@@ -55,6 +55,14 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
 
     actor_offset positions this host's actors inside the global eps_i
     schedule (host k of m runs indices [k*n, (k+1)*n) of num_actors*m).
+
+    param_poll_s=None (the default) paces parameter pulls by ENV STEPS:
+    the puller refreshes once the host's actors collectively advance
+    cfg.actors.param_pull_every frames per actor — Horgan et al. 2018's
+    "actors pull every ~400 env steps" — with a 30s keep-alive floor so
+    an idle host still tracks the live epoch. Passing a float restores
+    the fixed wall-clock cadence (bandwidth-constrained links where
+    seconds, not steps, are the budget).
 
     peer_id names this host on the fleet telemetry plane (obs/fleet.py);
     with obs enabled, experience batches are stamped with it plus a
@@ -130,6 +138,15 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         print("actor_host: AOT warmup unavailable; first query compiles "
               "lazily", file=sys.stderr, flush=True)
 
+    # step-paced pulls (param_poll_s=None) read the live actors' frame
+    # counters: refresh once the fleet advances param_pull_every frames
+    # per actor. The counters are plain ints bumped by the actor
+    # threads — a cadence heuristic, racy reads are fine.
+    live_actors: list = [None] * n
+    frame_paced = param_poll_s is None
+    poll_tick = 0.2 if frame_paced else param_poll_s
+    pull_every_frames = max(cfg.actors.param_pull_every, 1) * n
+
     def param_puller() -> None:
         # resilience contract: NOTHING in here may kill the thread — a
         # transient pull failure keeps last-good params on the server,
@@ -141,8 +158,18 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         seen_epoch = transport.param_epoch
         seen_pull_errors = transport.param_pull_errors
         fail_streak = 0
+        pulled_at_frames = 0
+        pulled_at_t = time.monotonic()
         while not stop_event.wait(
-                min(param_poll_s * (2 ** min(fail_streak, 4)), 30.0)):
+                min(poll_tick * (2 ** min(fail_streak, 4)), 30.0)):
+            if frame_paced and fail_streak == 0:
+                total = sum(a.frames for a in live_actors
+                            if a is not None)
+                if (total - pulled_at_frames < pull_every_frames
+                        and time.monotonic() - pulled_at_t < 30.0):
+                    continue
+                pulled_at_frames = total
+            pulled_at_t = time.monotonic()
             try:
                 # server-pushed params (if negotiated) take priority —
                 # they are publish-fresh; the conditional poll is the
@@ -186,6 +213,7 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         try:
             actor = cls(cfg, idx, query, transport,
                         obs=obs if obs.enabled else None)
+            live_actors[slot] = actor  # puller paces pulls off .frames
             frames[slot] = actor.run(per_actor, stop_event)
             obs.clear(f"actor-{idx}")  # finished, not stalled
         except Exception as e:  # noqa: BLE001 - reported to caller
@@ -251,13 +279,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--actors", type=int, default=None)
     ap.add_argument("--actor-offset", type=int, default=0)
     ap.add_argument("--frames-per-actor", type=int, default=None)
-    ap.add_argument("--param-poll-s", type=float, default=2.0,
-                    help="seconds between parameter pulls from the "
-                         "learner; each pull moves the full param tree "
-                         "over DCN, so on bandwidth-constrained links "
-                         "raise this toward the eps-staleness you can "
-                         "tolerate (Ape-X actors pull every ~400 env "
-                         "steps)")
+    ap.add_argument("--param-poll-s", type=float, default=None,
+                    help="fixed seconds between parameter pulls from "
+                         "the learner. Default: step-paced — pull once "
+                         "this host's actors advance "
+                         "actors.param_pull_every env steps each "
+                         "(Ape-X's ~400), 30s keep-alive. Each pull "
+                         "moves the full param tree over DCN, so on "
+                         "bandwidth-constrained links set the seconds "
+                         "toward the staleness you can tolerate")
     ap.add_argument("--peer-id", default=None,
                     help="name of this host on the fleet telemetry "
                          "plane (default: hostname-pid-a<offset>); "

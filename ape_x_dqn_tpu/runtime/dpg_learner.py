@@ -48,7 +48,7 @@ def continuous_item_spec(obs_shape, obs_dtype, action_dim: int) -> dict:
     }
 
 
-class DPGLearner:
+class DPGLearner:  # apexlint: parity(no train_step_k/sample_k/learn_k — K-chunked sampling is rejected by the constructor's ValueError gates; no evict_region/add_at — the cold tier is frame-ring only and DPG obs are low-dim)
     """Jitted endpoints for the Ape-X DPG learner."""
 
     def __init__(self, actor_apply: Callable, critic_apply: Callable,

@@ -104,7 +104,7 @@ class IngestStager:
         this almost never actually waits (the transfer overlapped the
         previous buffer's decode)."""
         if self._inflight[i]:
-            jax.block_until_ready(self._inflight[i])
+            jax.block_until_ready(self._inflight[i])  # apexlint: host-sync(deliberate reuse barrier: memory rewritten only after its transfer lands)
             self._inflight[i] = []
 
     def put(self, batch, tag=None) -> None:
@@ -129,7 +129,7 @@ class IngestStager:
             else:
                 for key in self._keys:
                     buf[key][self._cursor:self._cursor + k] = \
-                        np.asarray(batch[key])[start:start + k]
+                        np.asarray(batch[key])[start:start + k]  # apexlint: host-sync(wire batch is host numpy, not a device value)
             put_ms += (time.perf_counter() - t0) * 1e3
             self._cursor += k
             start += k
@@ -181,7 +181,7 @@ class IngestStager:
             # the shipped region becomes the compaction destination:
             # wait for its transfer before overwriting. Non-overlapping
             # copy: rem < block <= shipped.
-            jax.block_until_ready(handles)
+            jax.block_until_ready(handles)  # apexlint: host-sync(compaction barrier: shipped region is the copy destination)
             for k in self._keys:
                 buf[k][:rem] = buf[k][shipped:self._cursor]
         else:

@@ -2415,7 +2415,8 @@ def main() -> None:
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "ape_x_dqn_tpu"))
         secondary["apexlint"] = {"findings": len(lint["findings"]),
-                                 "waivers": lint["waivers"]}
+                                 "waivers": lint["waivers"],
+                                 "per_checker": lint["per_checker"]}
     except Exception as e:  # lint must never sink a bench run
         secondary["apexlint"] = {"error": repr(e)}
 

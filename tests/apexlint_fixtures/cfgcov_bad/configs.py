@@ -1,0 +1,9 @@
+"""Config-coverage BAD fixture: dataclass with one dead knob."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ReplayConfig:
+    capacity: int = 1 << 20
+    dead_knob: float = 0.5

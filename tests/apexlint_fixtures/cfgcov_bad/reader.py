@@ -1,0 +1,5 @@
+"""Reads capacity but never dead_knob — the knob does nothing."""
+
+
+def make_ring(cfg):
+    return [None] * cfg.capacity

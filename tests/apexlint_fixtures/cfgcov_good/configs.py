@@ -1,0 +1,9 @@
+"""Config-coverage GOOD fixture: every field read or waived."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ReplayConfig:
+    capacity: int = 1 << 20
+    fault_rate: float = 0.0  # apexlint: unread(reserved for the fault-injection harness; wired in its PR)

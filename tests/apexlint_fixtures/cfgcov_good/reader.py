@@ -1,0 +1,5 @@
+"""Reads capacity; fault_rate is waived as deliberately dormant."""
+
+
+def make_ring(cfg):
+    return [None] * cfg.capacity

@@ -1,0 +1,26 @@
+"""Use-after-donate BAD fixture.
+
+The driver calls the donating train_step and then returns the OLD
+state object — its device buffers were deleted on dispatch, so the
+read raises "Array has been deleted" on real TPUs (and silently works
+on CPU test runs). Exactly one finding, at the post-call read line.
+"""
+
+from functools import partial
+
+import jax
+
+
+class Learner:
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state):
+        return state, {"loss": 0.0}
+
+
+class Driver:
+    def __init__(self, learner):
+        self.learner = learner
+
+    def step(self, state):
+        new_state, metrics = self.learner.train_step(state)
+        return state, metrics

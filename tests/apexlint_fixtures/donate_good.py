@@ -1,0 +1,31 @@
+"""Use-after-donate GOOD fixture.
+
+Same donating learner; the driver uses the rebind-at-call idiom
+(`state, m = ...train_step(state)`) so the stale binding can never be
+read, plus one audited metadata read under a donated-ok waiver.
+Zero findings, one waiver.
+"""
+
+from functools import partial
+
+import jax
+
+
+class Learner:
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state):
+        return state, {"loss": 0.0}
+
+
+class Driver:
+    def __init__(self, learner):
+        self.learner = learner
+
+    def step(self, state):
+        state, metrics = self.learner.train_step(state)
+        return state, metrics
+
+    def step_audited(self, state):
+        out, metrics = self.learner.train_step(state)
+        shape = state.shape  # apexlint: donated-ok(aval metadata survives donation; no buffer read)
+        return out, shape

@@ -1,0 +1,14 @@
+# apexlint-scope: hot-path
+"""Hidden-host-sync BAD fixture.
+
+Opted into hot scope via the marker above (fixture files are not in
+HOT_BASENAMES). The unconditional float() on a jit output blocks the
+dispatch queue every iteration — exactly one finding.
+"""
+
+
+def learn_loop(learner, state, steps):
+    for _ in range(steps):
+        state, m = learner.train_step(state)
+        loss = float(m["loss"])
+    return state, loss

@@ -1,0 +1,22 @@
+# apexlint-scope: hot-path
+"""Hidden-host-sync GOOD fixture.
+
+Three sanctioned shapes: a sync inside an obs-gated branch (allowed
+window), one explicit waived fused fetch, and host reads of the
+already-fetched value (sanitized — free). Zero findings, one waiver.
+"""
+
+import jax
+
+
+def learn_loop(learner, state, obs, steps):
+    for _ in range(steps):
+        state, m = learner.train_step(state)
+        if obs.enabled:
+            obs.gauge("loss", float(m["loss"]))
+    return state
+
+
+def drain_metrics(state):
+    m = jax.device_get(state.metrics)  # apexlint: host-sync(one fused fetch at the log boundary)
+    return float(m["loss"]), float(m["grad_norm"])

@@ -1,0 +1,27 @@
+"""Learner-parity BAD fixture.
+
+Two leaf learners (both discovered via their donated jitted
+train_step); BetaLearner silently lacks the add() endpoint the other
+variant exposes, with no parity waiver declaring the asymmetry —
+exactly one finding, at BetaLearner's class def line.
+"""
+
+from functools import partial
+
+import jax
+
+
+class AlphaLearner:
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state):
+        return state, {"diag": {}}
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add(self, state, items, pris):
+        return state
+
+
+class BetaLearner:
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state):
+        return state, {"diag": {}}

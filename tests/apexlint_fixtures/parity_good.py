@@ -1,0 +1,27 @@
+"""Learner-parity GOOD fixture.
+
+Same two-learner shape; the asymmetry is DECLARED: BetaLearner's
+class-line parity waiver names the missing endpoint (`add`), so the
+drift is an audited decision, not silence. Zero findings, one waiver.
+A waiver that did not mention `add` would not absorb the finding.
+"""
+
+from functools import partial
+
+import jax
+
+
+class AlphaLearner:
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state):
+        return state, {"diag": {}}
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add(self, state, items, pris):
+        return state
+
+
+class BetaLearner:  # apexlint: parity(no add — beta ingests through alpha's staging ring)
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def train_step(self, state):
+        return state, {"diag": {}}

@@ -1,0 +1,16 @@
+"""Cross-module jit-purity BAD fixture, jit half.
+
+The jit boundary is here; the host effect it reaches lives in
+xjit_bad_util.residual_scale. Checked together the pair must yield
+exactly one finding, anchored at the time.time() line in the util
+module.
+"""
+
+import jax
+
+from xjit_bad_util import residual_scale
+
+
+@jax.jit
+def train(x):
+    return residual_scale(x) + 1.0

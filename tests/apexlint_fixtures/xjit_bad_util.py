@@ -1,0 +1,17 @@
+"""Cross-module jit-purity BAD fixture, helper half.
+
+Pure-looking residual helper that actually reads the host clock — the
+impurity lives here, one module away from the jit boundary in
+xjit_bad_entry.py, which is exactly what the v1 module-local pass
+could not see.
+"""
+
+import time
+
+
+def residual_scale(x):
+    return x * time.time()
+
+
+def double(x):
+    return x * 2

@@ -1,0 +1,11 @@
+"""Cross-module jit-purity GOOD fixture, jit half: the same import +
+call shape as the bad twin, but the reachable helper is pure."""
+
+import jax
+
+from xjit_good_util import residual_scale
+
+
+@jax.jit
+def train(x):
+    return residual_scale(x, 0.5) + 1.0

@@ -1,0 +1,9 @@
+"""Cross-module jit-purity GOOD fixture, helper half: pure math only."""
+
+
+def residual_scale(x, scale):
+    return x * scale
+
+
+def double(x):
+    return x * 2

@@ -24,8 +24,9 @@ FIXTURES = os.path.join(REPO_ROOT, "tests", "apexlint_fixtures")
 sys.path.insert(0, REPO_ROOT)  # tools/ is repo-local, not installed
 
 from tools.apexlint import run as apexlint_run  # noqa: E402
-from tools.apexlint import guarded_by, jit_purity, obs_names, \
-    retry_annotation, wire_protocol  # noqa: E402
+from tools.apexlint import config_coverage, guarded_by, host_sync, \
+    jit_purity, learner_parity, obs_names, retry_annotation, \
+    use_after_donate, wire_protocol  # noqa: E402
 
 
 def _fx(name: str) -> str:
@@ -54,7 +55,51 @@ def test_cli_json_subprocess():
     assert summary["findings"] == []
     assert set(summary["per_checker"]) == {
         "guarded-by", "jit-purity", "wire-protocol", "obs-names",
-        "retry-annotation"}
+        "retry-annotation", "use-after-donate", "host-sync",
+        "config-coverage", "learner-parity"}
+    # per-checker shape feeds bench.py's secondary.apexlint lane
+    for counts in summary["per_checker"].values():
+        assert set(counts) == {"findings", "waivers"}
+
+
+def test_cli_sarif_subprocess():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.apexlint", "ape_x_dqn_tpu/",
+         "--format=sarif"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    sarif = json.loads(out.stdout)
+    assert sarif["version"] == "2.1.0"
+    driver = sarif["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "apexlint"
+    assert {r["id"] for r in driver["rules"]} >= {
+        "use-after-donate", "host-sync", "learner-parity"}
+    assert sarif["runs"][0]["results"] == []
+
+
+def test_cli_changed_only_filters_and_annotates():
+    # vs HEAD with a clean tree the package has no changed findings
+    # either way (the gate is already zero); the mode must still run
+    # the whole-program analysis and annotate the summary
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.apexlint", "ape_x_dqn_tpu/",
+         "--changed-only", "HEAD", "--format=json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(out.stdout)
+    assert summary["findings"] == []
+    assert summary["changed_only"]["ref"] == "HEAD"
+    # analysis stayed whole-program: all files scanned, all checkers ran
+    assert summary["checked_files"] > 50
+    assert "learner-parity" in summary["per_checker"]
+
+
+def test_cli_self_dogfood():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.apexlint", "--self"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 finding(s)" in out.stdout
 
 
 def test_cli_text_nonzero_exit_on_findings(tmp_path):
@@ -100,6 +145,114 @@ def test_jit_purity_fixtures():
     assert f.checker == "jit-purity"
     assert "time.time" in f.message
     assert "_timed_residual" in f.message  # names the reachable hop
+
+
+def test_jit_purity_cross_module_fixtures():
+    """v2: the jit boundary and the host effect live in DIFFERENT
+    modules — the checker must follow `from x import y` through the
+    call graph and anchor the finding at the effect's line in the
+    helper module."""
+    good = jit_purity.check_paths(
+        [_fx("xjit_good_entry.py"), _fx("xjit_good_util.py")])
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = jit_purity.check_paths(
+        [_fx("xjit_bad_entry.py"), _fx("xjit_bad_util.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "jit-purity"
+    assert "time.time" in f.message
+    assert "residual_scale" in f.message  # names the cross-module hop
+    assert f.path.endswith("xjit_bad_util.py")  # anchored at the effect
+
+    # module-local degeneration: the entry file alone cannot see the
+    # impurity (the import resolves to nothing and stays opaque)
+    alone = jit_purity.check_paths([_fx("xjit_bad_entry.py")])
+    assert alone.findings == []
+
+
+def test_use_after_donate_fixtures():
+    good = use_after_donate.check_paths([_fx("donate_good.py")])
+    assert good.findings == []
+    assert good.waivers == 1  # the audited metadata read
+
+    bad = use_after_donate.check_paths([_fx("donate_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "use-after-donate"
+    assert "state" in f.message and "train_step" in f.message
+    assert "deleted" in f.message
+
+
+def test_host_sync_fixtures():
+    good = host_sync.check_paths([_fx("hostsync_good.py")])
+    assert good.findings == []
+    assert good.waivers == 1  # the one explicit fused fetch
+
+    bad = host_sync.check_paths([_fx("hostsync_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "host-sync"
+    assert "float()" in f.message
+    assert "learn_loop" in f.message
+
+
+def test_host_sync_scope_is_opt_in(tmp_path):
+    # the same sync OUTSIDE a hot module (no marker, basename not in
+    # HOT_BASENAMES) is not flagged: checkpointing and teardown code
+    # legitimately syncs
+    bad_src = open(_fx("hostsync_bad.py"), encoding="utf-8").read()
+    elsewhere = tmp_path / "elsewhere.py"
+    elsewhere.write_text(bad_src.replace("# apexlint-scope: hot-path", ""))
+    res = host_sync.check_paths([str(elsewhere)])
+    assert res.findings == []
+
+
+def test_config_coverage_fixtures():
+    good_dir = _fx("cfgcov_good")
+    good_paths = [os.path.join(good_dir, n)
+                  for n in ("configs.py", "reader.py")]
+    good = config_coverage.check(
+        good_paths, readme_path=os.path.join(good_dir, "README.md"))
+    assert good.findings == []
+    assert good.waivers == 1  # the declared-dormant fault_rate
+
+    bad_dir = _fx("cfgcov_bad")
+    bad_paths = [os.path.join(bad_dir, n)
+                 for n in ("configs.py", "reader.py")]
+    bad = config_coverage.check(
+        bad_paths, readme_path=os.path.join(bad_dir, "README.md"))
+    msgs = [f.message for f in bad.findings]
+    assert any("dead_knob" in m and "read nowhere" in m for m in msgs)
+    assert any("phantom_knob" in m and "no field" in m for m in msgs)
+    assert len(bad.findings) == 2
+
+
+def test_learner_parity_fixtures():
+    good = learner_parity.check_paths([_fx("parity_good.py")])
+    assert good.findings == []
+    assert good.waivers == 1  # the declared add() asymmetry
+
+    bad = learner_parity.check_paths([_fx("parity_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "learner-parity"
+    assert "BetaLearner" in f.message and "add()" in f.message
+    assert "AlphaLearner" in f.message  # names who has the endpoint
+
+
+def test_learner_parity_waiver_must_name_endpoint(tmp_path):
+    # a parity waiver that does not MENTION the drifted endpoint does
+    # not absorb the finding — blanket waivers can't hide future drift
+    src = open(_fx("parity_good.py"), encoding="utf-8").read()
+    blanket = tmp_path / "parity_blanket.py"
+    blanket.write_text(src.replace(
+        "parity(no add — beta ingests through alpha's staging ring)",
+        "parity(beta is special)"))
+    res = learner_parity.check_paths([str(blanket)])
+    assert len(res.findings) == 1
+    assert "add()" in res.findings[0].message
 
 
 def test_wire_protocol_fixtures():

@@ -1,20 +1,36 @@
 """apexlint: project-native static analysis for the Ape-X runtime.
 
-Five stdlib-only AST checkers over the package source (no imports of
-the code under analysis, no third-party deps):
+Nine stdlib-only AST checkers over the package source (no imports of
+the code under analysis, no third-party deps). The v1 five are
+single-file passes; v2 added a shared cross-module call graph
+(callgraph.py) and four whole-program dataflow checkers:
 
-- guarded-by   lock discipline for `# guarded-by: <lock>` attributes
-- jit-purity   no host effects reachable from jax.jit boundaries
-- wire-protocol every MSG_* handled in every dispatch chain
-- obs-names    emitted instruments <-> obs/report.py table, both ways
+- guarded-by       lock discipline for `# guarded-by: <lock>` attrs
+- jit-purity       no host effects reachable from jax.jit boundaries
+                   (package-wide reachability through imports, module
+                   aliases, and cross-module inheritance)
+- wire-protocol    every MSG_* handled in every dispatch chain
+- obs-names        emitted instruments <-> obs/report.py table
 - retry-annotation swallowed socket errors in comm/runtime must emit
-  an obs counter/accounting bump or carry `# apexlint: lossy(reason)`
+                   an accounting bump or carry `# apexlint: lossy(...)`
+- use-after-donate no reads of a buffer after it was donated to a
+                   `donate_argnums` jit without an intervening rebind
+- host-sync        no hidden `.item()`/`np.asarray`/`float()`/
+                   `block_until_ready` device syncs in the hot-path
+                   modules outside obs windows
+- config-coverage  every configs.py dataclass field is read somewhere;
+                   every README `replay./comm./obs./actors.` knob exists
+- learner-parity   the four learner variants' jitted endpoint surfaces
+                   (names, donation pattern, metrics["diag"] threading)
+                   stay in lockstep (ROADMAP item 5's enforcement)
 
-CLI: `python -m tools.apexlint ape_x_dqn_tpu/ [--format=json]`
-exits 0 only with zero unwaived findings; tests/test_apexlint.py runs
-it over the package as a tier-1 gate. The dynamic companion (the
-lock-order witness) lives in ape_x_dqn_tpu/obs/health.py, enabled
-under APEX_LOCK_WITNESS=1 by tests/conftest.py.
+CLI: `python -m tools.apexlint ape_x_dqn_tpu/ [--format=json|sarif]
+[--changed-only <git-ref>] [--self]` exits 0 only with zero unwaived
+findings; tests/test_apexlint.py runs it over the package as a tier-1
+gate, and `--self` dogfoods the structural checkers on tools/ itself.
+The dynamic companion (the lock-order witness) lives in
+ape_x_dqn_tpu/obs/health.py, enabled under APEX_LOCK_WITNESS=1 by
+tests/conftest.py.
 """
 
 from __future__ import annotations
@@ -22,7 +38,8 @@ from __future__ import annotations
 import os
 
 from tools.apexlint import (
-    guarded_by, jit_purity, obs_names, retry_annotation, wire_protocol)
+    config_coverage, guarded_by, host_sync, jit_purity, learner_parity,
+    obs_names, retry_annotation, use_after_donate, wire_protocol)
 from tools.apexlint.common import CheckResult, Finding, ModuleSource
 
 __all__ = ["CheckResult", "Finding", "ModuleSource", "run",
@@ -40,21 +57,39 @@ def package_files(package_dir: str) -> list[str]:
 
 
 def run(package_dir: str,
-        report_path: str | None = None) -> dict:
+        report_path: str | None = None,
+        readme_path: str | None = None) -> dict:
     """Run all checkers over a package tree; returns the JSON-shaped
-    summary the CLI, tests, and bench.py all consume."""
+    summary the CLI, tests, and bench.py all consume.
+
+    per_checker maps each checker to {"findings": n, "waivers": n} so
+    waiver creep is attributable per rule in the bench artifact trail
+    (`secondary.apexlint`); top-level `findings`/`waivers` stay the
+    aggregate view.
+    """
     paths = package_files(package_dir)
     total = CheckResult()
-    per_checker: dict[str, int] = {}
+    per_checker: dict[str, dict[str, int]] = {}
 
     def fold(name: str, res: CheckResult) -> None:
-        per_checker[name] = len(res.findings)
+        per_checker[name] = {"findings": len(res.findings),
+                             "waivers": res.waivers}
         total.merge(res)
 
     fold("guarded-by", guarded_by.check_paths(paths))
     fold("jit-purity", jit_purity.check_paths(paths))
     fold("wire-protocol", wire_protocol.check_paths(paths))
     fold("retry-annotation", retry_annotation.check_paths(paths))
+    fold("use-after-donate", use_after_donate.check_paths(paths))
+    fold("host-sync", host_sync.check_paths(paths))
+    fold("learner-parity", learner_parity.check_paths(paths))
+    if readme_path is None:
+        candidate = os.path.join(
+            os.path.dirname(os.path.abspath(package_dir.rstrip(os.sep))),
+            "README.md")
+        readme_path = candidate if os.path.exists(candidate) else None
+    fold("config-coverage",
+         config_coverage.check(paths, readme_path=readme_path))
     if report_path is None:
         candidate = os.path.join(package_dir, "obs", "report.py")
         report_path = candidate if os.path.exists(candidate) else None

@@ -1,36 +1,123 @@
-"""CLI: `python -m tools.apexlint <package_dir> [--format=json]`."""
+"""CLI: `python -m tools.apexlint <package_dir> [options]`.
+
+--format=text|json|sarif   sarif emits SARIF 2.1.0 for code-scanning
+                           UIs (one rule per checker).
+--changed-only <git-ref>   fast mode: the WHOLE-PROGRAM analysis still
+                           runs (cross-module checkers need the full
+                           graph), but findings are filtered to files
+                           changed vs <git-ref> (plus untracked files)
+                           and the exit code reflects only those. CI
+                           keeps the full run; this is the pre-push
+                           loop.
+--self                     dogfood: lint tools/ itself with the
+                           structural checkers (package-specific
+                           tables — configs, README knobs, obs report
+                           — auto-skip when absent).
+"""
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
 from tools.apexlint import run
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(summary: dict) -> dict:
+    rules = sorted(summary["per_checker"])
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "apexlint",
+                "informationUri": "tools/apexlint",
+                "rules": [{"id": r} for r in rules],
+            }},
+            "results": [{
+                "ruleId": f["checker"],
+                "level": "error",
+                "message": {"text": f["message"]},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f["path"]},
+                    "region": {"startLine": f["line"]},
+                }}],
+            } for f in summary["findings"]],
+        }],
+    }
+
+
+def changed_files(ref: str) -> set[str]:
+    """Files changed vs `ref` plus untracked files, repo-relative and
+    normalized for comparison against finding paths."""
+    out: set[str] = set()
+    for args in (["git", "diff", "--name-only", ref, "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(args, capture_output=True, text=True,
+                              timeout=60)
+        if proc.returncode != 0:
+            raise SystemExit(f"apexlint: {' '.join(args)} failed: "
+                             f"{proc.stderr.strip()}")
+        out.update(os.path.normpath(line)
+                   for line in proc.stdout.splitlines() if line.strip())
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.apexlint",
         description="Ape-X project lint: guarded-by, jit-purity, "
-                    "wire-protocol, obs-names.")
-    ap.add_argument("package", help="package directory to scan "
-                                    "(e.g. ape_x_dqn_tpu/)")
-    ap.add_argument("--format", choices=("text", "json"),
+                    "wire-protocol, obs-names, retry-annotation, "
+                    "use-after-donate, host-sync, config-coverage, "
+                    "learner-parity.")
+    ap.add_argument("package", nargs="?", default=None,
+                    help="package directory to scan (e.g. "
+                         "ape_x_dqn_tpu/)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
                     default="text")
+    ap.add_argument("--changed-only", metavar="GIT_REF", default=None,
+                    help="filter findings (and the exit code) to files "
+                         "changed vs GIT_REF; the analysis itself stays "
+                         "whole-program")
+    ap.add_argument("--self", action="store_true", dest="self_lint",
+                    help="lint tools/ itself (dogfood)")
     args = ap.parse_args(argv)
+    if args.package is None:
+        if not args.self_lint:
+            ap.error("package directory required (or --self)")
+        args.package = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     summary = run(args.package)
+    if args.changed_only is not None:
+        changed = changed_files(args.changed_only)
+        summary["findings"] = [
+            f for f in summary["findings"]
+            if os.path.normpath(f["path"]) in changed]
+        summary["changed_only"] = {"ref": args.changed_only,
+                                   "changed_files": len(changed)}
     if args.format == "json":
         print(json.dumps(summary))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(summary), indent=2))
     else:
         for f in summary["findings"]:
             print(f"{f['path']}:{f['line']}: [{f['checker']}] "
                   f"{f['message']}")
-        counts = ", ".join(f"{k}={v}" for k, v in
-                           sorted(summary["per_checker"].items()))
+        counts = ", ".join(
+            f"{k}={v['findings']}/{v['waivers']}w" for k, v in
+            sorted(summary["per_checker"].items()))
+        scope = (f" [changed vs {args.changed_only}]"
+                 if args.changed_only else "")
         print(f"apexlint: {len(summary['findings'])} finding(s), "
               f"{summary['waivers']} waiver(s) across "
-              f"{summary['checked_files']} files ({counts})")
+              f"{summary['checked_files']} files{scope} ({counts})")
     return 1 if summary["findings"] else 0
 
 

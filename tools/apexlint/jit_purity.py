@@ -1,11 +1,16 @@
 """Jit-purity checker: no host effects reachable from a jit boundary.
 
 A function is a *jit root* when it is decorated `@jax.jit` /
-`@partial(jax.jit, ...)`, or passed to `jax.jit(fn)` as a module-local
+`@partial(jax.jit, ...)`, or passed to `jax.jit(fn)` as a module-level
 function, same-class method (`jax.jit(self._step)`), or inline lambda.
-From each root the checker walks the module-local call graph (calls to
-module-level functions and to `self.<method>` within the same class)
-and flags host-effect calls anywhere in the reachable bodies:
+From each root the checker walks the PACKAGE-WIDE call graph
+(tools/apexlint/callgraph.py): calls to module-level functions (local
+or imported via `from x import y`), to `self.<method>` including
+methods inherited from base classes in other modules, and to
+`alias.fn` on known module aliases (`from ape_x_dqn_tpu.obs import
+learning as learn_obs` — the jits call `learn_obs.sgd_diag` and the
+checker follows it into obs/learning.py). Host-effect calls anywhere
+in the reachable bodies are flagged:
 
 - wall-clock reads / sleeps (`time.time`, `time.monotonic`, ...)
 - `print(...)` (use `jax.debug.print` inside traced code)
@@ -18,17 +23,19 @@ and flags host-effect calls anywhere in the reachable bodies:
 Inside jit these either fail loudly (tracer leak), or worse, succeed
 once at trace time and then never run again — a metric that reports
 the compile-time value forever. Waive a deliberate trace-time effect
-with `# apexlint: host-effect(<why>)`.
+with `# apexlint: host-effect(<why>)` on the effect's line (the line
+in the module where the effect lives, which may not be the module
+with the jit boundary).
 
-The call graph is module-local by design: cross-module helpers called
-from jit are checked when their own module is scanned (every module
-with a jit callsite is in the scan set).
+`check_paths([one_file])` degenerates to the v1 module-local pass —
+imports that leave the scan set resolve to None and stay opaque.
 """
 
 from __future__ import annotations
 
 import ast
 
+from tools.apexlint.callgraph import CallGraph, ClassInfo, FuncInfo
 from tools.apexlint.common import (
     CheckResult, Finding, ModuleSource, dotted_name)
 
@@ -51,109 +58,82 @@ def _is_jax_jit(node: ast.expr) -> bool:
     return dotted_name(node) in ("jax.jit", "jit")
 
 
-def _jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+def jit_decorator(fn: ast.FunctionDef | ast.AsyncFunctionDef
+                  ) -> ast.expr | None:
+    """The decorator expression that makes `fn` a jit, or None."""
     for dec in fn.decorator_list:
         if _is_jax_jit(dec):
-            return True
+            return dec
         if isinstance(dec, ast.Call):
             if _is_jax_jit(dec.func):
-                return True
+                return dec
             # @partial(jax.jit, ...)
             if (dotted_name(dec.func) in ("partial", "functools.partial")
                     and dec.args and _is_jax_jit(dec.args[0])):
-                return True
-    return False
+                return dec
+    return None
 
 
-class _ModuleIndex:
-    """Module-level functions and per-class methods, by name."""
-
-    def __init__(self, tree: ast.Module):
-        self.functions: dict[str, ast.FunctionDef] = {}
-        self.methods: dict[str, dict[str, ast.FunctionDef]] = {}
-        self.owner: dict[int, str | None] = {}  # id(fn-node) -> class
-        for node in tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.functions[node.name] = node
-                self.owner[id(node)] = None
-            elif isinstance(node, ast.ClassDef):
-                table: dict[str, ast.FunctionDef] = {}
-                for item in node.body:
-                    if isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
-                        table[item.name] = item
-                        self.owner[id(item)] = node.name
-                self.methods[node.name] = table
-
-    def resolve(self, call: ast.Call,
-                cls: str | None) -> ast.FunctionDef | None:
-        func = call.func
-        if isinstance(func, ast.Name):
-            return self.functions.get(func.id)
-        if (cls is not None and isinstance(func, ast.Attribute)
-                and isinstance(func.value, ast.Name)
-                and func.value.id == "self"):
-            return self.methods.get(cls, {}).get(func.attr)
-        return None
+def _jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    return jit_decorator(fn) is not None
 
 
-def _jit_roots(index: _ModuleIndex,
-               tree: ast.Module) -> list[tuple[ast.AST, str | None]]:
-    """(function-or-lambda node, owning-class) for every jit boundary."""
-    roots: list[tuple[ast.AST, str | None]] = []
-    for name, fn in index.functions.items():
-        if _jit_decorated(fn):
-            roots.append((fn, None))
-    for cls, table in index.methods.items():
-        for name, fn in table.items():
-            if _jit_decorated(fn):
-                roots.append((fn, cls))
+def _jit_roots(graph: CallGraph) -> list[FuncInfo]:
+    """Every jit boundary across the scanned modules."""
+    roots: list[FuncInfo] = []
+    for mod in graph.modules:
+        for fn in mod.functions.values():
+            if _jit_decorated(fn.node):
+                roots.append(fn)
+        for cls in mod.classes.values():
+            for fn in cls.methods.values():
+                if _jit_decorated(fn.node):
+                    roots.append(fn)
 
-    # jax.jit(<arg>) callsites anywhere in the module
-    def walk(node: ast.AST, cls: str | None) -> None:
-        if isinstance(node, ast.ClassDef):
+        # jax.jit(<arg>) callsites anywhere in the module
+        def walk(node: ast.AST, cls: ClassInfo | None) -> None:
+            if isinstance(node, ast.ClassDef):
+                owner = mod.classes.get(node.name)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, owner)
+                return
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                if node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name):
+                        target = mod.functions.get(arg.id)
+                        if target is not None:
+                            roots.append(target)
+                    elif isinstance(arg, ast.Lambda):
+                        roots.append(FuncInfo(arg, mod, cls))
+                    elif (isinstance(arg, ast.Attribute)
+                          and isinstance(arg.value, ast.Name)
+                          and arg.value.id == "self" and cls is not None):
+                        target = graph.lookup_method(cls, arg.attr)
+                        if target is not None:
+                            roots.append(target)
             for child in ast.iter_child_nodes(node):
-                walk(child, node.name)
-            return
-        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
-            if node.args:
-                arg = node.args[0]
-                if isinstance(arg, ast.Name):
-                    target = index.functions.get(arg.id)
-                    if target is not None:
-                        roots.append((target, None))
-                elif isinstance(arg, ast.Lambda):
-                    roots.append((arg, cls))
-                elif (isinstance(arg, ast.Attribute)
-                      and isinstance(arg.value, ast.Name)
-                      and arg.value.id == "self" and cls is not None):
-                    target = index.methods.get(cls, {}).get(arg.attr)
-                    if target is not None:
-                        roots.append((target, cls))
-        for child in ast.iter_child_nodes(node):
-            walk(child, cls)
+                walk(child, cls)
 
-    walk(tree, None)
+        walk(mod.src.tree, None)
     return roots
 
 
-def _reachable(index: _ModuleIndex,
-               roots: list[tuple[ast.AST, str | None]]
-               ) -> list[tuple[ast.AST, str | None]]:
+def _reachable(graph: CallGraph, roots: list[FuncInfo]) -> list[FuncInfo]:
     seen: set[int] = set()
-    out: list[tuple[ast.AST, str | None]] = []
+    out: list[FuncInfo] = []
     work = list(roots)
     while work:
-        fn, cls = work.pop()
-        if id(fn) in seen:
+        fn = work.pop()
+        if id(fn.node) in seen:
             continue
-        seen.add(id(fn))
-        out.append((fn, cls))
-        for node in ast.walk(fn):
+        seen.add(id(fn.node))
+        out.append(fn)
+        for node in ast.walk(fn.node):
             if isinstance(node, ast.Call):
-                target = index.resolve(node, cls)
-                if target is not None and id(target) not in seen:
-                    work.append((target, index.owner.get(id(target))))
+                target = graph.resolve_call(node, fn.module, fn.cls)
+                if target is not None and id(target.node) not in seen:
+                    work.append(target)
     return out
 
 
@@ -187,32 +167,30 @@ def _host_effect(call: ast.Call) -> str | None:
     return None
 
 
-def check_module(src: ModuleSource) -> CheckResult:
+def check_graph(graph: CallGraph) -> CheckResult:
     result = CheckResult()
-    index = _ModuleIndex(src.tree)
-    roots = _jit_roots(index, src.tree)
-    seen_lines: set[int] = set()
-    for fn, _cls in _reachable(index, roots):
-        fn_name = getattr(fn, "name", "<lambda>")
-        for node in ast.walk(fn):
+    roots = _jit_roots(graph)
+    seen_sites: set[tuple[str, int]] = set()
+    for fn in _reachable(graph, roots):
+        src = fn.module.src
+        for node in ast.walk(fn.node):
             if not isinstance(node, ast.Call):
                 continue
             effect = _host_effect(node)
-            if effect is None or node.lineno in seen_lines:
+            site = (src.path, node.lineno)
+            if effect is None or site in seen_sites:
                 continue
-            seen_lines.add(node.lineno)
+            seen_sites.add(site)
             if src.waiver(node.lineno, "host-effect") is not None:
                 result.waivers += 1
                 continue
             result.findings.append(Finding(
                 CHECKER, src.path, node.lineno,
                 f"{effect} — reachable from a jax.jit boundary via "
-                f"{fn_name}()"))
+                f"{fn.name}()"))
+    result.findings.sort(key=lambda f: (f.path, f.line))
     return result
 
 
 def check_paths(paths: list[str]) -> CheckResult:
-    result = CheckResult()
-    for path in paths:
-        result.merge(check_module(ModuleSource(path)))
-    return result
+    return check_graph(CallGraph([ModuleSource(p) for p in paths]))

@@ -1,0 +1,276 @@
+"""Use-after-donate checker: no reads of a donated buffer after dispatch.
+
+Every learner/replay jit donates its state (`donate_argnums=1` with
+`static_argnums=0` on methods, `jax.jit(fn, donate_argnums=(0,))` on
+module-level wrappers). After such a call the donated argument's device
+buffers are DELETED — any later read (attribute access, re-pass to
+another dispatch, host fetch) raises "Array has been deleted" on real
+TPUs while often *appearing* to work on CPU test runs, which is exactly
+the class of bug that only fires in production.
+
+The checker collects every donating callable across the scanned
+modules (decorated `@partial(jax.jit, ..., donate_argnums=...)`
+functions/methods and `name = jax.jit(fn, donate_argnums=...)`
+bindings), maps donated indices to call-site argument positions
+(methods burn index 0 on `self`), then scans every function body for
+calls to those names. Call sites are matched by callable name AND
+call-site arity — `seen.add(x)` does not match the replay's
+3-argument `add(state, batch, pris)` — a deliberately coarse match
+that errs quiet on dynamic dispatch.
+
+For each matched call the donated argument expression is rooted
+(`state`, `self.state`, or `X._replace(...)` which donates X's
+buffers), then the enclosing function is scanned line-forward:
+
+- a REBIND of the root (including at the call statement itself —
+  `self.state = self.learner.add(self.state, ...)`) makes the path
+  safe and ends the scan;
+- a READ of the root before any rebind is a finding at the read line.
+
+Audited deliberate patterns (the driver's eviction swap reads the jit
+*outputs*, never the donated input, so it is naturally clean; a true
+read-after-donate that is provably safe on this backend) carry
+`# apexlint: donated-ok(<why>)` on the read line or the call line.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.apexlint.callgraph import CallGraph, ClassInfo, FuncInfo
+from tools.apexlint.common import (
+    CheckResult, Finding, ModuleSource, dotted_name)
+from tools.apexlint.jit_purity import jit_decorator
+
+CHECKER = "use-after-donate"
+
+
+@dataclass
+class Donor:
+    """One donating callable: name, donated call-site positions, and
+    the call-site arity window used to disambiguate name collisions."""
+    name: str
+    positions: tuple[int, ...]
+    min_arity: int
+    max_arity: int
+
+
+def _int_tuple(node: ast.expr | None) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _jit_kwargs(call: ast.Call) -> dict[str, ast.expr]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+def _fn_arity(fn: ast.FunctionDef | ast.AsyncFunctionDef,
+              drop_self: bool) -> tuple[int, int]:
+    args = fn.args
+    pos = list(args.posonlyargs) + list(args.args)
+    n = len(pos) - (1 if drop_self and pos
+                    and pos[0].arg in ("self", "cls") else 0)
+    return n - len(args.defaults), n
+
+
+def collect_donors(graph: CallGraph) -> list[Donor]:
+    donors: list[Donor] = []
+
+    def from_decorated(fn: FuncInfo, is_method: bool) -> None:
+        dec = jit_decorator(fn.node)
+        if not isinstance(dec, ast.Call):
+            return
+        donated = _int_tuple(_jit_kwargs(dec).get("donate_argnums"))
+        if not donated:
+            return
+        shift = 1 if is_method else 0
+        positions = tuple(sorted(d - shift for d in donated
+                                 if d - shift >= 0))
+        lo, hi = _fn_arity(fn.node, drop_self=is_method)
+        donors.append(Donor(fn.name, positions, lo, hi))
+
+    for mod in graph.modules:
+        for fn in mod.functions.values():
+            from_decorated(fn, is_method=False)
+        for cls in mod.classes.values():
+            for fn in cls.methods.values():
+                from_decorated(fn, is_method=True)
+        # name = jax.jit(fn, donate_argnums=...) bindings
+        for node in ast.walk(mod.src.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and dotted_name(node.value.func) in ("jax.jit", "jit")):
+                continue
+            donated = _int_tuple(
+                _jit_kwargs(node.value).get("donate_argnums"))
+            if not donated or not node.value.args:
+                continue
+            wrapped = node.value.args[0]
+            lo, hi = 0, 64
+            if isinstance(wrapped, ast.Name):
+                target = mod.functions.get(wrapped.id)
+                if target is not None:
+                    lo, hi = _fn_arity(target.node, drop_self=False)
+            for tgt in node.targets:
+                name = tgt.id if isinstance(tgt, ast.Name) else (
+                    tgt.attr if isinstance(tgt, ast.Attribute) else None)
+                if name:
+                    donors.append(Donor(name, tuple(sorted(donated)),
+                                        lo, hi))
+    return donors
+
+
+# -- donated-expression rooting ---------------------------------------
+
+def _root(expr: ast.expr) -> tuple[str, ...] | None:
+    """('state',) for `state`, ('self', 'state') for `self.state`;
+    `X._replace(...)` / `X.replace(...)` roots to X (a functional
+    update still hands X's buffers to the donating dispatch)."""
+    if (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in ("_replace", "replace")):
+        return _root(expr.func.value)
+    if isinstance(expr, ast.Name):
+        return (expr.id,)
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return ("self", expr.attr)
+    return None
+
+
+def _matches_root(expr: ast.expr, root: tuple[str, ...]) -> bool:
+    if len(root) == 1:
+        return isinstance(expr, ast.Name) and expr.id == root[0]
+    return (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self" and expr.attr == root[1])
+
+
+def _assigned_roots(node: ast.AST) -> list[tuple[tuple[str, ...], int]]:
+    """Roots rebound by an assignment-like node (tuple targets too)."""
+    targets: list[ast.expr] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, (ast.For, ast.AsyncFor)):
+        targets = [node.target]
+    elif isinstance(node, ast.withitem) and node.optional_vars:
+        targets = [node.optional_vars]
+    out: list[tuple[tuple[str, ...], int]] = []
+
+    def visit(t: ast.expr) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                visit(e)
+            return
+        if isinstance(t, ast.Starred):
+            visit(t.value)
+            return
+        r = _root(t)
+        if r is not None:
+            out.append((r, t.lineno))
+
+    for t in targets:
+        visit(t)
+    return out
+
+
+def _check_function(fn: FuncInfo, donors_by_name: dict[str, list[Donor]],
+                    result: CheckResult) -> None:
+    src = fn.module.src
+    body = fn.node
+    # all rebind and read events for the whole function, by line: the
+    # scan is linear-by-line, which matches the straight-line dispatch
+    # sequences this package writes (loops re-enter at the call line,
+    # where the rebind-at-call rule already covers them)
+    events: list[tuple[int, str, tuple[str, ...], ast.AST]] = []
+    for node in ast.walk(body):
+        for root, line in _assigned_roots(node):
+            events.append((line, "store", root, node))
+        if isinstance(node, (ast.Name, ast.Attribute)) \
+                and isinstance(getattr(node, "ctx", None), ast.Load):
+            r = _root(node)
+            if r is not None and not (isinstance(node, ast.Name)
+                                      and r == ("self",)):
+                events.append((node.lineno, "load", r, node))
+    events.sort(key=lambda e: e[0])
+
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = None
+        if isinstance(node.func, ast.Name):
+            callee = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            callee = node.func.attr
+        if callee not in donors_by_name:
+            continue
+        arity = len(node.args) + len(node.keywords)
+        donor = next((d for d in donors_by_name[callee]
+                      if d.min_arity <= arity <= d.max_arity), None)
+        if donor is None:
+            continue
+        for pos in donor.positions:
+            if pos >= len(node.args):
+                continue  # passed by keyword / defaulted: out of scope
+            root = _root(node.args[pos])
+            if root is None:
+                continue
+            call_line = node.lineno
+            # rebind at the call statement itself is the safe idiom
+            rebound = any(e_line == call_line and kind == "store"
+                          and e_root == root
+                          for e_line, kind, e_root, _ in events)
+            if rebound:
+                continue
+            flagged = False
+            for e_line, kind, e_root, e_node in events:
+                if e_line <= call_line or e_root != root:
+                    continue
+                if kind == "store":
+                    break
+                if src.waiver(e_line, "donated-ok") is not None \
+                        or src.waiver(call_line, "donated-ok") is not None:
+                    result.waivers += 1
+                    flagged = True
+                    break
+                result.findings.append(Finding(
+                    CHECKER, src.path, e_line,
+                    f"reads {'.'.join(root)} after it was donated to "
+                    f"{callee}() at line {call_line} — the buffers are "
+                    f"deleted on dispatch; rebind the result or copy "
+                    f"before donating"))
+                flagged = True
+                break
+            if flagged:
+                continue
+
+
+def check_graph(graph: CallGraph) -> CheckResult:
+    result = CheckResult()
+    donors = collect_donors(graph)
+    by_name: dict[str, list[Donor]] = {}
+    for d in donors:
+        by_name.setdefault(d.name, []).append(d)
+    for mod in graph.modules:
+        fns: list[FuncInfo] = list(mod.functions.values())
+        for cls in mod.classes.values():
+            fns.extend(cls.methods.values())
+        for fn in fns:
+            _check_function(fn, by_name, result)
+    result.findings.sort(key=lambda f: (f.path, f.line))
+    return result
+
+
+def check_paths(paths: list[str]) -> CheckResult:
+    return check_graph(CallGraph([ModuleSource(p) for p in paths]))
