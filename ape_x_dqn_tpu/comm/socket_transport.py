@@ -632,6 +632,11 @@ class SocketIngestServer:
         # MSG_TELEMETRY frame identifies itself as a peer; its loss is
         # then attributed (counter + warning + hook) instead of silent
         self._conn_peers: dict[int, str] = {}  # guarded-by: _conns_lock
+        # serving-tier tenant tags: a connection that offered a serve
+        # tag in its hello is attributed to (policy_id, priority
+        # class) — the learner-side admission controller and report
+        # can then name WHICH tenant's actors a connection carries
+        self._conn_serve: dict[int, tuple[str, int]] = {}  # guarded-by: _conns_lock
         self._telemetry_frames = 0  # guarded-by: _conns_lock
         self._telemetry_bytes_in = 0  # guarded-by: _conns_lock
         self._peer_disconnects = 0  # guarded-by: _conns_lock
@@ -825,6 +830,17 @@ class SocketIngestServer:
             return len(self._push_subs)
 
     @property
+    def serve_peers(self) -> dict[str, int]:
+        """Live connections per serving-tier tenant tag, as
+        policy_id -> connection count (untagged connections — old
+        clients, single-tenant fleets — simply don't appear)."""
+        with self._conns_lock:
+            out: dict[str, int] = {}
+            for policy, _cls in self._conn_serve.values():
+                out[policy] = out.get(policy, 0) + 1
+            return out
+
+    @property
     def pending(self) -> int:
         return self._q.qsize()
 
@@ -1005,13 +1021,24 @@ class SocketIngestServer:
                     # exchange: granted iff the client offered it (an
                     # old client never does, so this server never
                     # expects frames from it).
+                    serve_tag: tuple[str, int] | None = None
                     try:
                         hello = json.loads(bytes(payload))
                         offered = hello.get("codecs", [])
                         wants_tel = bool(hello.get("telemetry"))
                         wants_push = bool(hello.get("params_push"))
-                    except (ValueError, AttributeError):
+                        # serving-tier tenant tag, negotiated like the
+                        # telemetry capability: an OLD client never
+                        # offers one, an OLD server (this code absent)
+                        # ignores unknown offer keys — both directions
+                        # degrade to untagged traffic
+                        serve = hello.get("serve")
+                        if isinstance(serve, dict) and serve.get("policy"):
+                            serve_tag = (str(serve["policy"]),
+                                         int(serve.get("class", 0)))
+                    except (ValueError, AttributeError, TypeError):
                         offered, wants_tel, wants_push = [], False, False
+                        serve_tag = None
                     grant = self._codec if self._codec in offered \
                         else "raw"
                     # the epoch rides every ack: an old client never
@@ -1023,6 +1050,10 @@ class SocketIngestServer:
                         ack["telemetry"] = True
                     if wants_push:
                         ack["params_push"] = True
+                    if serve_tag is not None:
+                        with self._conns_lock:
+                            self._conn_serve[id(conn)] = serve_tag
+                        ack["serve"] = True
                     # ack FIRST, subscribe after: if a publish is already
                     # pending, a push thread registered before the ack is
                     # on the wire could win the conn's send lock and make
@@ -1096,6 +1127,7 @@ class SocketIngestServer:
                     pass
                 self._conn_send_locks.pop(id(conn), None)
                 self._push_subs.pop(id(conn), None)
+                self._conn_serve.pop(id(conn), None)
                 self._last_disconnect = time.monotonic()
                 peer = self._conn_peers.pop(id(conn), None)
                 if peer is not None:
@@ -1195,7 +1227,8 @@ class SocketTransport:
                  hello_timeout: float = 2.0, telemetry: bool = True,
                  reconnect_base_s: float = 0.05,
                  reconnect_cap_s: float = 2.0,
-                 params_push: bool = False):
+                 params_push: bool = False,
+                 serve_policy: str = "", serve_class: int = 0):
         """telemetry: offer the fleet-telemetry capability in the
         connect-time hello. send_telemetry only ships frames after the
         server granted it, so leaving this on against an old server
@@ -1210,19 +1243,38 @@ class SocketTransport:
         capability; when granted, MSG_PARAMS_PUSH frames arrive on the
         experience socket and poll_pushed_params() hands them over —
         against an old server the offer is ignored and polling is the
-        only path."""
+        only path.
+
+        serve_policy/serve_class: serving-tier tenant tag offered in
+        the hello ("" = untagged, the single-tenant default). A new
+        server records the tag for per-tenant attribution and echoes
+        the capability; an old server ignores the unknown offer key —
+        experience flows untagged either way. The tag also arms
+        set_backpressure: the serving tier's admission controller can
+        then shed THIS host's sends during overload windows."""
         self._addr = (host, port)
         self._timeout = connect_timeout
         self._codec = _check_codec(wire_codec)
         self._hello_timeout = hello_timeout
         self._telemetry = bool(telemetry)
         self._params_push = bool(params_push)
+        self._serve_policy = str(serve_policy)
+        self._serve_class = int(serve_class)
         self._reconnect_base_s = max(float(reconnect_base_s), 1e-3)
         self._reconnect_cap_s = max(float(reconnect_cap_s),
                                     self._reconnect_base_s)
         self._negotiated: str = "raw"  # guarded-by: _send_lock
         self._telemetry_ok = False  # guarded-by: _send_lock
         self._push_ok = False  # guarded-by: _send_lock
+        self._serve_ok = False  # guarded-by: _send_lock
+        # serving-tier backpressure latch: while engaged, experience
+        # sends drop host-side (counted under the existing
+        # "backpressure" drop reason) instead of deepening an already
+        # over-SLO admission queue. A plain bool flipped by
+        # set_backpressure from the tier's controller thread and read
+        # in the send path — GIL-atomic, deliberately lock-free so the
+        # controller never blocks on a slow send
+        self._bp_engaged = False
         self._telemetry_frames_out = 0  # guarded-by: _send_lock
         self._telemetry_bytes_out = 0  # guarded-by: _send_lock
         self._sock: socket.socket | None = None  # guarded-by: _send_lock
@@ -1351,7 +1403,9 @@ class SocketTransport:
         self._negotiated = "raw"  # apexlint: unguarded(caller holds _send_lock)
         self._telemetry_ok = False  # apexlint: unguarded(caller holds _send_lock)
         self._push_ok = False  # apexlint: unguarded(caller holds _send_lock)
-        if self._codec != "raw" or self._telemetry or self._params_push:
+        self._serve_ok = False  # apexlint: unguarded(caller holds _send_lock)
+        if (self._codec != "raw" or self._telemetry
+                or self._params_push or self._serve_policy):
             # the hello now also fires with a raw codec when telemetry
             # is wanted — an old server still just ignores it
             try:
@@ -1359,6 +1413,9 @@ class SocketTransport:
                          "telemetry": self._telemetry}
                 if self._params_push:
                     offer["params_push"] = True
+                if self._serve_policy:
+                    offer["serve"] = {"policy": self._serve_policy,
+                                      "class": self._serve_class}
                 _send_msg(sock, MSG_HELLO, json.dumps(offer).encode())
                 sock.settimeout(self._hello_timeout)
                 msg = _recv_msg(sock)
@@ -1371,6 +1428,8 @@ class SocketTransport:
                         self._telemetry_ok = True  # apexlint: unguarded(caller holds _send_lock)
                     if self._params_push and bool(ack.get("params_push")):
                         self._push_ok = True  # apexlint: unguarded(caller holds _send_lock)
+                    if self._serve_policy and bool(ack.get("serve")):
+                        self._serve_ok = True  # apexlint: unguarded(caller holds _send_lock)
                     ep = ack.get("epoch")
                     if isinstance(ep, int):
                         self._note_epoch(ep)
@@ -1483,9 +1542,12 @@ class SocketTransport:
             # backoff gate: inside a backoff window the batch drops
             # WITHOUT touching the network — hammering a dead learner
             # from every actor thread at full send rate is how
-            # reconnect storms start
-            if self._sock is None \
-                    and time.monotonic() < self._backoff_until:
+            # reconnect storms start. The serving tier's backpressure
+            # latch drops through the same accounted path: an over-SLO
+            # learner asked this host to stop deepening the queue.
+            if self._bp_engaged or (self._sock is None
+                                    and time.monotonic()
+                                    < self._backoff_until):
                 self._dropped += 1
                 self._drop_reasons["backpressure"] += 1
                 return
@@ -1514,6 +1576,14 @@ class SocketTransport:
                     reason = self._note_send_failure(e)
             self._dropped += 1
             self._drop_reasons[reason] += 1
+
+    def set_backpressure(self, engaged: bool) -> None:
+        """Engage/release the serving-tier backpressure latch: while
+        engaged, send_experience drops host-side under the existing
+        accounted "backpressure" reason instead of pushing more load
+        at an over-SLO learner. Called by the admission controller's
+        on_backpressure hook; thread-safe (plain bool flip)."""
+        self._bp_engaged = bool(engaged)
 
     def send_telemetry(self, frame: dict) -> bool:
         """Best-effort ship of one obs snapshot frame (MSG_TELEMETRY,
@@ -1700,6 +1770,14 @@ class SocketTransport:
         """Codec agreed with the current learner connection ("raw"
         until a hello/ack has succeeded)."""
         return self._negotiated
+
+    @property
+    def serve_negotiated(self) -> bool:
+        """True when the server acknowledged this host's serving-tier
+        tenant tag on the current connection (False against an old
+        server or before the first send connects)."""
+        with self._send_lock:
+            return self._serve_ok
 
     @property
     def telemetry_negotiated(self) -> bool:

@@ -241,6 +241,56 @@ class InferenceConfig:
 
 
 @dataclass(frozen=True)
+class ServingConfig:
+    """Multi-tenant serving tier (parallel/inference_server.py,
+    MultiPolicyInferenceServer). Off by default: drivers then build the
+    single-tenant BatchedInferenceServer exactly as before. On, every
+    policy registers into one continuous-batching tier — per-policy
+    epoch-versioned params, priority-class admission, load-shedding,
+    and per-tenant serve/<tenant>/ SLO gauges."""
+
+    # route inference through the multi-tenant tier (drivers register
+    # their policy under env.id; actor hosts tag wire hellos with it)
+    multi_tenant: bool = False
+    # admission classes; class 0 is the top class and is never shed
+    priority_classes: int = 3
+    # class that ordinary actor traffic rides in (eval workers and
+    # other latency-sensitive callers should use a lower number)
+    default_class: int = 1
+    # pending-item depth where the admission controller starts
+    # shedding lower classes and engages transport backpressure
+    # (hysteresis: releases at half this depth)
+    queue_slo_items: int = 256
+    # per-request admission-queue deadline; an expired request raises
+    # ServeDeadlineExceeded naming its policy_id. 0 disables.
+    request_deadline_ms: float = 0.0
+    # per-tenant serve/<tenant>/ gauge publish cadence
+    stats_every_s: float = 1.0
+    # coalesce same-family tenants into one stacked/gather-indexed
+    # forward (off: mixed batches still work, one dispatch per batch
+    # is only guaranteed per single-tenant batch)
+    coalesce: bool = True
+    # propagate the admission controller's backpressure signal onto
+    # the experience transport (SocketTransport.set_backpressure)
+    backpressure: bool = True
+
+    def __post_init__(self) -> None:
+        if self.priority_classes < 1:
+            raise ValueError(
+                f"serving.priority_classes must be >= 1 "
+                f"(got {self.priority_classes})")
+        if not 0 <= self.default_class < self.priority_classes:
+            raise ValueError(
+                f"serving.default_class must be in "
+                f"[0, {self.priority_classes}) "
+                f"(got {self.default_class})")
+        if self.queue_slo_items < 1:
+            raise ValueError(
+                f"serving.queue_slo_items must be >= 1 "
+                f"(got {self.queue_slo_items})")
+
+
+@dataclass(frozen=True)
 class ParallelConfig:
     dp: int = 1  # data-parallel (ICI) learner shards
     tp: int = 1  # tensor-parallel shards for dense layers
@@ -373,6 +423,9 @@ class RunConfig:
     learner: LearnerConfig = field(default_factory=LearnerConfig)
     actors: ActorConfig = field(default_factory=ActorConfig)
     inference: InferenceConfig = field(default_factory=InferenceConfig)
+    # multi-tenant serving tier (off = single-tenant server, bitwise
+    # the pre-tier path); enable with --set serving.multi_tenant=true
+    serving: ServingConfig = field(default_factory=ServingConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     comm: CommConfig = field(default_factory=CommConfig)
     # observability (ape_x_dqn_tpu/obs): off by default; enable with

@@ -192,6 +192,28 @@ INSTRUMENTS = {
                  "data and its guard is broken")},
     "cold_evictions": {"kind": "ctr"},
     "cold_recalls": {"kind": "ctr"},
+    # multi-tenant serving tier (parallel/inference_server.py, ISSUE
+    # 13): admission-controller accounting closes by construction —
+    # serve_offered == serve_admitted + serve_shed at quiescence (shed
+    # includes deadline expiries; serve_expired counts those
+    # separately). Per-tenant duplicates ride dynamic
+    # `serve/<tenant>/<stat>` gauge keys (regrouped by summarize(),
+    # invisible to lint by design — same policy as learn/ and peer/
+    # keys); the per-tenant p99_ms rows are checked against
+    # infer_latency_ms's healthy bound in check_violations.
+    "serve_offered": {"kind": "ctr"},
+    "serve_admitted": {"kind": "ctr"},
+    "serve_shed": {"kind": "ctr"},
+    "serve_expired": {"kind": "ctr"},
+    "serve_tenants": {"kind": "gauge"},
+    "serve_backpressure": {"kind": "gauge"},
+    "serve_queue_items": {
+        "kind": "gauge",
+        "warn": ("value", 256,
+                 "admission-queue depth beyond queue_slo_items means "
+                 "offered load exceeds serving capacity — the "
+                 "controller is shedding lower classes and "
+                 "backpressuring the transport")},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -293,6 +315,16 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         parts = k.split("/", 2)
         if len(parts) == 3:
             tenants.setdefault(parts[1], {})[parts[2]] = v
+    # per-tenant serving stats: `gauge/serve/<policy_id>/<stat>` keys
+    # (parallel/inference_server._maybe_publish_stats) regroup into one
+    # dict per tenant — the serving tier's equivalent of learn/ keys
+    serving: dict[str, dict[str, Any]] = {}
+    for k, v in gauges.items():
+        if not k.startswith("serve/"):
+            continue
+        parts = k.split("/", 2)
+        if len(parts) == 3:
+            serving.setdefault(parts[1], {})[parts[2]] = v
     ctrs = {k[len("ctr/"):]: v for k, v in latest.items()
             if k.startswith("ctr/")}
     hbm = {k[len("hbm/"):]: v for k, v in latest.items()
@@ -319,6 +351,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "peers": peers,
         "multichip": multichip,
         "tenants": tenants,
+        "serving": serving,
         "virtual_devices": latest.get("virtual_devices"),
         "disconnects": disconnects,
         "stalls": stalls,
@@ -626,6 +659,58 @@ def _fmt_learning(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+def _fmt_serving(summary: dict[str, Any]) -> list[str]:
+    """Serving-tier section (multi-tenant inference, ISSUE 13): the
+    admission controller's aggregate accounting plus a per-tenant table
+    from the `serve/<tenant>/` gauges, each tenant's p99 flagged
+    against the infer_latency_ms healthy bound."""
+    ctrs = summary.get("ctrs", {})
+    gauges = summary.get("gauges", {})
+    serving = summary.get("serving", {})
+    if "serve_offered" not in ctrs and not serving:
+        return []
+    offered = int(ctrs.get("serve_offered", 0))
+    admitted = int(ctrs.get("serve_admitted", 0))
+    shed = int(ctrs.get("serve_shed", 0))
+    expired = int(ctrs.get("serve_expired", 0))
+    bp = gauges.get("serve_backpressure")
+    lines = [
+        "serving tier (multi-tenant admission):",
+        f"  offered={offered} admitted={admitted} shed={shed} "
+        f"(of which expired={expired}) "
+        f"tenants={_n(gauges.get('serve_tenants'))} "
+        f"queue_depth={_n(gauges.get('serve_queue_items'))} "
+        f"backpressure={'ENGAGED' if bp else 'off'}"]
+    # the closure invariant the admission tests assert; a report over a
+    # live (non-quiescent) stream may show a small in-flight gap
+    if offered and offered != admitted + shed:
+        lines.append(f"    (in-flight gap: offered - admitted - shed = "
+                     f"{offered - admitted - shed} requests still "
+                     f"queued at last publish)")
+    if serving:
+        p99_bound = HEALTHY["infer_latency_ms"][1]
+        lines.append(f"  tenants ({len(serving)}):")
+        for t in sorted(serving):
+            d = serving[t]
+
+            def tn(key: str, d=d) -> str:
+                v = d.get(key)
+                return _n(float(v)) if v is not None else "-"
+
+            lines.append(
+                f"    {t:<22} p50_ms={tn('p50_ms')} "
+                f"p99_ms={tn('p99_ms')} depth={tn('queue_depth')} "
+                f"offered={tn('offered')} admitted={tn('admitted')} "
+                f"shed={tn('shed')}")
+            p99 = d.get("p99_ms")
+            if p99 is not None and float(p99) > p99_bound:
+                lines.append(
+                    f"      ⚠ p99={_n(float(p99))}ms exceeds healthy "
+                    f"~{_n(float(p99_bound))}ms: "
+                    f"{HEALTHY['infer_latency_ms'][2]}")
+    return lines
+
+
 def _fmt_learn_events(summary: dict[str, Any]) -> list[str]:
     """LearnMonitor `learning_degradation` events (warn-only; the run
     continued), attributed to the env family that tripped the rule."""
@@ -757,6 +842,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if slo_lines:
         lines.append("")
         lines.extend(slo_lines)
+    serving_lines = _fmt_serving(summary)
+    if serving_lines:
+        lines.append("")
+        lines.extend(serving_lines)
     ingest_lines = _fmt_ingest(summary)
     if ingest_lines:
         lines.append("")
@@ -821,6 +910,15 @@ def check_violations(summary: dict[str, Any]) -> list[str]:
             if v is not None and float(v) > bound:
                 out.append(f"{name}: {kind}={_n(float(v))} > healthy "
                            f"{_n(float(bound))} — {why}")
+    # per-tenant serving latency: every serve/<tenant>/p99_ms gauge is
+    # held to the same bound as the aggregate infer_latency_ms hist —
+    # a single overloaded tenant must not hide inside a healthy mean
+    _, lat_bound, lat_why = HEALTHY["infer_latency_ms"]
+    for tenant, d in sorted(summary.get("serving", {}).items()):
+        p99 = d.get("p99_ms")
+        if p99 is not None and float(p99) > lat_bound:
+            out.append(f"serve/{tenant}/p99_ms: value={_n(float(p99))} "
+                       f"> healthy {_n(float(lat_bound))} — {lat_why}")
     return out
 
 

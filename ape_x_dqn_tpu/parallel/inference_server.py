@@ -22,6 +22,21 @@ all-gather over ICI to inference-server shards"). Buckets round up to a
 multiple of the mesh size so every shard gets identical work; the dist
 learner's `publish_params` already hands over mesh-replicated buffers,
 so a publication is exactly the ICI all-gather the survey names.
+
+Multi-tenant serving tier (ISSUE 13): `MultiPolicyInferenceServer`
+serves MANY policies from one chip behind a single continuous-batching
+admission queue. Requests are tagged (policy_id, priority class);
+an admission thread moves them into per-family priority deques while
+the dispatch thread is forwarding — admission never waits on a
+collect-then-serve round. Same-family tenants coalesce into one
+stacked/gather-indexed forward (`vmap` over per-example params rows),
+so 57 heads cost one dispatch, not 57. The admission controller sheds
+load from the lowest priority class first when queue depth crosses the
+SLO line (class 0 is never shed), expires requests past their deadline
+with errors attributed to the policy_id, and raises/clears a
+backpressure signal the transport layer can act on. Drivers talk to
+the tier through `register_policy`'s TenantClient, which keeps the
+exact BatchedInferenceServer client surface.
 """
 
 from __future__ import annotations
@@ -29,9 +44,11 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -95,9 +112,14 @@ class BatchedInferenceServer:
         self._max_batch = max_batch
         self._deadline_s = deadline_ms / 1000.0
         self._q: queue.Queue[_Request] = queue.Queue()
-        # a popped-but-not-admitted request (would overflow max_batch)
-        # held for the next batch — only the serve thread touches it
-        self._held: _Request | None = None
+        # popped-but-not-admitted requests (would overflow max_batch)
+        # held in arrival order for later batches — only the serve
+        # thread touches it
+        self._held: deque[_Request] = deque()
+        # bucket sizes already AOT-compiled: warmup() is re-entrant
+        # across update_params epochs without re-paying compiles —
+        # only the caller's thread touches it (warmup is pre-traffic)
+        self._warm_buckets: set[int] = set()
         self._stop = threading.Event()
         # _lock guards the published params (swapped by the driver's
         # ingest thread, read by the serve thread) and the served-stat
@@ -170,20 +192,20 @@ class BatchedInferenceServer:
         # request sizes (not doubling _bucket(1)) matters when the mesh
         # size is not a power of two: buckets are pow2 rounded up to a
         # mesh-size multiple, which doubling would skip.
-        sizes = set()
-        n = 1
-        while n < self._max_batch:
-            sizes.add(self._bucket(n))
-            n *= 2
-        sizes.add(self._bucket(self._max_batch))
-        sizes.update(self._bucket(s) for s in extra_sizes if s >= 1)
-        for b in sorted(sizes):
+        sizes = _pow2_bucket_sizes(self._bucket, self._max_batch,
+                                   extra_sizes)
+        # dedupe against already-warm buckets: an update_params epoch
+        # bump changes VALUES, not shapes/dtypes, so re-warming after a
+        # publication would re-pay every AOT compile for nothing
+        # (asserted via the jit_compiles compile-telemetry delta)
+        for b in sorted(sizes - self._warm_buckets):
             stacked = jax.tree.map(
                 lambda x: np.zeros((b, *np.asarray(x).shape),
                                    np.asarray(x).dtype), example_input)
             if self._batched_sharding is not None:
                 stacked = jax.device_put(stacked, self._batched_sharding)
             self._apply.lower(params, stacked).compile()
+            self._warm_buckets.add(b)
 
     # -- learner side ------------------------------------------------------
 
@@ -218,24 +240,37 @@ class BatchedInferenceServer:
     # -- server loop -------------------------------------------------------
 
     def _collect(self) -> list[_Request]:
-        if self._held is not None:
-            first, self._held = self._held, None
-        else:
-            try:
-                first = self._q.get(timeout=0.05)
-            except queue.Empty:
-                return []
-        reqs = [first]
-        items = first.items
-        deadline = time.monotonic() + self._deadline_s
         # max_batch counts ITEMS, not requests: a vector actor's K-item
         # request fills K slots of the batch budget. A request that
-        # would overflow the budget is HELD for the next batch (never
+        # would overflow the budget is HELD for a later batch (never
         # split) — otherwise a coalesced batch could exceed max_batch
         # and land in a bucket warmup never compiled (a 10-40s TPU
         # stall that times out every waiting actor). A single oversized
         # request still serves alone: its own bucket was warmed via
-        # warmup's extra_sizes.
+        # warmup's extra_sizes. Holding is NOT a barrier: a held-back
+        # oversize request must not starve smaller requests that still
+        # fit the current bucket, so non-fitting requests are parked
+        # (arrival order preserved) while collection keeps admitting.
+        reqs: list[_Request] = []
+        items = 0
+        kept: deque[_Request] = deque()
+        while self._held:
+            r = self._held.popleft()
+            if (items + r.items <= self._max_batch
+                    or (not reqs and r.items >= self._max_batch)):
+                reqs.append(r)
+                items += r.items
+            else:
+                kept.append(r)
+        self._held = kept
+        if not reqs:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                return []
+            reqs.append(first)
+            items = first.items
+        deadline = time.monotonic() + self._deadline_s
         while items < self._max_batch:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -245,8 +280,8 @@ class BatchedInferenceServer:
             except queue.Empty:
                 break
             if items + r.items > self._max_batch:
-                self._held = r
-                break
+                self._held.append(r)
+                continue
             reqs.append(r)
             items += r.items
         return reqs
@@ -325,3 +360,763 @@ def _pad_concat(xs: tuple, padded: int) -> np.ndarray:
         pad_width = [(0, padded - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
         arr = np.pad(arr, pad_width)
     return arr
+
+
+def _pow2_bucket_sizes(bucket_fn: Callable[[int], int], max_batch: int,
+                       extra_sizes: tuple[int, ...]) -> set[int]:
+    """Every bucket a pow2 REQUEST size up to max_batch can land in:
+    coalesced batches hit any of them (e.g. 2-3 K-item vector requests
+    -> bucket 2K/4K, truncation flushes -> small buckets), and a cold
+    intermediate bucket under load stalls every queued actor behind one
+    compile. Mapping the bucket fn over request sizes (not doubling
+    bucket(1)) matters when the mesh size is not a power of two:
+    buckets are pow2 rounded up to a mesh-size multiple, which doubling
+    would skip."""
+    sizes = set()
+    n = 1
+    while n < max_batch:
+        sizes.add(bucket_fn(n))
+        n *= 2
+    sizes.add(bucket_fn(max_batch))
+    sizes.update(bucket_fn(s) for s in extra_sizes if s >= 1)
+    return sizes
+
+
+# -- multi-tenant serving tier (ISSUE 13) ----------------------------------
+
+
+class ServeShed(RuntimeError):
+    """Request shed by the admission controller: queue depth crossed
+    the SLO line and this request sat in a sheddable (non-top) priority
+    class. Attributed so the caller knows WHICH tenant lost work."""
+
+    def __init__(self, policy_id: str, priority: int):
+        super().__init__(
+            f"request for policy {policy_id!r} (class {priority}) shed: "
+            f"admission queue over the SLO line")
+        self.policy_id = policy_id
+        self.priority = priority
+
+
+class ServeDeadlineExceeded(TimeoutError):
+    """Request expired in the admission queue before dispatch. The
+    timeout is ATTRIBUTED — it names the policy_id and class — so an
+    overloaded tenant shows up in actor logs as itself, not as a
+    generic server stall."""
+
+    def __init__(self, policy_id: str, priority: int, waited_ms: float):
+        super().__init__(
+            f"request for policy {policy_id!r} (class {priority}) "
+            f"expired after {waited_ms:.0f}ms in the admission queue")
+        self.policy_id = policy_id
+        self.priority = priority
+
+
+class _ServeRequest:
+    __slots__ = ("policy", "prio", "inputs", "n", "event", "result",
+                 "t_enq")
+
+    def __init__(self, policy: str, prio: int, inputs: Any, n: int = 0):
+        self.policy = policy
+        self.prio = prio
+        self.inputs = inputs
+        self.n = n
+        self.event = threading.Event()
+        self.result: Any = None
+        self.t_enq = time.perf_counter()
+
+    @property
+    def items(self) -> int:
+        return self.n if self.n else 1
+
+    def wait(self, timeout: float = 60.0) -> Any:
+        """Block until served; raises the attributed shed/deadline
+        error if the admission controller rejected the request."""
+        if not self.event.wait(timeout):
+            raise TimeoutError("inference server did not reply")
+        if isinstance(self.result, Exception):
+            raise self.result
+        return self.result
+
+
+class _Policy:
+    """One registered tenant: epoch-versioned params plus its row in
+    the family's stacked param tree and per-tenant accounting."""
+
+    __slots__ = ("policy_id", "family", "params", "version", "row",
+                 "offered", "admitted", "shed", "pending_items",
+                 "lat_ms")
+
+    def __init__(self, policy_id: str, family: str, params: Any,
+                 version: int, row: int):
+        self.policy_id = policy_id
+        self.family = family
+        self.params = params
+        self.version = version
+        self.row = row
+        self.offered = 0
+        self.admitted = 0
+        self.shed = 0
+        self.pending_items = 0
+        # recent end-to-end latencies (ms) for the per-tenant p50/p99
+        # gauges; a bounded reservoir, appended only by the dispatch
+        # thread, snapshotted by the stats publisher
+        self.lat_ms: deque[float] = deque(maxlen=512)
+
+
+class _Family:
+    """One apply-fn family: the tenants it serves, their stacked param
+    cache for the coalesced forward, per-class pending deques, and the
+    warm-bucket memo for both forward paths."""
+
+    __slots__ = ("name", "apply_plain", "apply_gather", "policies",
+                 "stacked", "dirty", "pending", "pending_items",
+                 "warm_plain", "warm_gather")
+
+    def __init__(self, name: str, apply_plain: Callable,
+                 apply_gather: Callable, classes: int):
+        self.name = name
+        self.apply_plain = apply_plain
+        self.apply_gather = apply_gather
+        self.policies: list[_Policy] = []
+        self.stacked: Any = None
+        self.dirty = True
+        self.pending: list[deque[_ServeRequest]] = [
+            deque() for _ in range(classes)]
+        self.pending_items = 0
+        self.warm_plain: set[int] = set()
+        self.warm_gather: set[int] = set()
+
+
+def _make_gather_apply(apply_fn: Callable) -> Callable:
+    """Coalesced multi-tenant forward: params leaves carry a leading
+    [n_policies] axis, `rows` maps each batch item to its tenant's
+    row, and vmap over (gathered per-example params, batch) runs every
+    head in ONE dispatch — 57 tenants never mean 57 forwards. The
+    gather materializes per-example param rows, so it pays ~batch x
+    head-params HBM; the intended regime is many small per-tenant
+    heads over a shared torso."""
+
+    def one(p: Any, x: Any) -> Any:
+        out = apply_fn(p, jax.tree.map(lambda leaf: leaf[None], x))
+        return jax.tree.map(lambda leaf: leaf[0], out)
+
+    def run(stacked_params: Any, rows: Any, batch: Any) -> Any:
+        per = jax.tree.map(lambda p: p[rows], stacked_params)
+        return jax.vmap(one)(per, batch)
+
+    return run
+
+
+class TenantClient:
+    """Per-tenant view of a MultiPolicyInferenceServer with the exact
+    BatchedInferenceServer client/learner surface (query, query_batch,
+    warmup, update_params, params_version, queue_depth, stats, stop),
+    so drivers, actor hosts and the eval worker are tenant-tagged
+    without signature changes. Every query it submits carries this
+    view's (policy_id, priority class)."""
+
+    def __init__(self, tier: "MultiPolicyInferenceServer",
+                 policy_id: str, priority: int):
+        self._tier = tier
+        self.policy_id = policy_id
+        self.priority = priority
+
+    def submit(self, inputs: Any, n: int = 0) -> _ServeRequest:
+        """Non-blocking admission: returns a ticket whose .wait()
+        yields the result (or raises the attributed shed/deadline
+        error). The open-loop path for benches and load generators."""
+        return self._tier.submit(self.policy_id, self.priority,
+                                 inputs, n)
+
+    def query(self, inputs: Any, timeout: float = 60.0) -> Any:
+        return self.submit(inputs).wait(timeout)
+
+    def query_batch(self, inputs: Any, n: int,
+                    timeout: float = 60.0) -> Any:
+        assert n >= 1
+        return self.submit(inputs, n).wait(timeout)
+
+    def warmup(self, example_input: Any,
+               extra_sizes: tuple[int, ...] = ()) -> None:
+        self._tier.warmup(self.policy_id, example_input,
+                          extra_sizes=extra_sizes)
+
+    def update_params(self, params: Any, version: int) -> None:
+        self._tier.update_params(self.policy_id, params, version)
+
+    @property
+    def params_version(self) -> int:
+        return self._tier.policy_version(self.policy_id)
+
+    @property
+    def queue_depth(self) -> int:
+        return self._tier.queue_depth
+
+    @property
+    def stats(self) -> dict:
+        return self._tier.tenant_stats(self.policy_id)
+
+    def stop(self) -> None:
+        # views share the tier; stop is idempotent there
+        self._tier.stop()
+
+
+class MultiPolicyInferenceServer:
+    """Continuous-batching multi-policy serving tier (module docstring
+    has the architecture sketch).
+
+    Threads: "serving-admission" drains the intake queue into
+    per-family per-class pending deques, shedding from the lowest
+    class when depth crosses `queue_slo_items` and driving the
+    backpressure signal; "serving-dispatch" builds priority-ordered
+    batches (class 0 first, FIFO within a class, oversize requests
+    parked without head-of-line blocking) and runs one forward per
+    batch — plain jit when the batch is single-tenant, the stacked/
+    gather-indexed coalesced forward when tenants mix. Admission keeps
+    running while a forward is in flight: capacity freeing IS the
+    admission signal, there are no collect-then-serve rounds."""
+
+    def __init__(self, max_batch: int = 64, deadline_ms: float = 2.0,
+                 *, mesh: Mesh | None = None, obs: Any = None,
+                 priority_classes: int = 3, queue_slo_items: int = 256,
+                 request_deadline_ms: float = 0.0,
+                 stats_every_s: float = 1.0, coalesce: bool = True):
+        """priority_classes: number of admission classes; class 0 is
+        the top class and is NEVER shed. queue_slo_items: pending-item
+        depth above which the admission controller sheds lower classes
+        and engages backpressure (hysteresis: disengages at half).
+        request_deadline_ms: per-request admission-queue deadline
+        (0 disables); expiry raises ServeDeadlineExceeded naming the
+        policy_id. coalesce: allow the stacked/gather-indexed
+        multi-tenant forward (single-tenant batches always take the
+        plain path). Mesh mode shards the plain path exactly like
+        BatchedInferenceServer; the coalesced path runs unsharded."""
+        assert priority_classes >= 1
+        self._classes = int(priority_classes)
+        self._max_batch = max_batch
+        self._deadline_s = deadline_ms / 1000.0
+        self._slo_items = int(queue_slo_items)
+        self._req_deadline_s = request_deadline_ms / 1000.0
+        self._stats_every_s = float(stats_every_s)
+        self._coalesce = bool(coalesce)
+        self._mesh = mesh
+        if mesh is not None:
+            self._batched_sharding = NamedSharding(
+                mesh, P(tuple(mesh.axis_names)))
+            self._params_sharding = NamedSharding(mesh, P())
+            self._min_bucket = int(mesh.size)
+        else:
+            self._batched_sharding = None
+            self._params_sharding = None
+            self._min_bucket = 1
+        self._q: queue.Queue[_ServeRequest] = queue.Queue()
+        # _lock guards the registry, every pending deque, the stacked
+        # param caches and all serve accounting; admission, dispatch,
+        # register/update and stats readers all cross it
+        self._lock = make_lock("serving_tier._lock")
+        self._policies: dict[str, _Policy] = {}  # guarded-by: _lock
+        self._families: dict[str, _Family] = {}  # guarded-by: _lock
+        self._pending_items = 0  # guarded-by: _lock
+        self._offered = 0  # guarded-by: _lock
+        self._admitted = 0  # guarded-by: _lock
+        self._shed_by_class = [0] * self._classes  # guarded-by: _lock
+        self._expired = 0  # guarded-by: _lock
+        self._batches_served = 0  # guarded-by: _lock
+        self._items_served = 0  # guarded-by: _lock
+        self._bp_engaged = False  # guarded-by: _lock
+        self._stats_last = time.monotonic()  # dispatch thread only
+        # transport hook: called with True/False on backpressure
+        # transitions (engage when depth crosses the SLO line, release
+        # at half); installed by the host before traffic, called from
+        # the admission/dispatch threads
+        self.on_backpressure: Callable[[bool], None] | None = None
+        self._stop_evt = threading.Event()
+        self._work = threading.Event()
+        self._obs = obs if obs is not None else NULL_OBS
+        self._obs.register("inference-server")
+        self._admit_thread = threading.Thread(
+            target=self._admit_loop, name="serving-admission",
+            daemon=True)
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="serving-dispatch",
+            daemon=True)
+        self._admit_thread.start()
+        self._dispatch_thread.start()
+
+    # -- registry ----------------------------------------------------------
+
+    def register_policy(self, policy_id: str, apply_fn: Callable,
+                        params: Any, *, family: str = "default",
+                        priority: int = 0,
+                        version: int = 0) -> TenantClient:
+        """Register one tenant and return its TenantClient view.
+
+        Tenants sharing `family` must share apply semantics (same net
+        applied to per-tenant params) — the family's jitted forwards
+        come from the FIRST registration; only params differ per
+        tenant. Registration invalidates the family's stacked-param
+        cache and coalesced warm set (the stack gains a row, which is
+        a new compile shape)."""
+        with self._lock:
+            if policy_id in self._policies:
+                raise ValueError(f"policy {policy_id!r} already "
+                                 f"registered")
+            fam = self._families.get(family)
+            if fam is None:
+                if self._params_sharding is not None:
+                    plain = jax.jit(
+                        apply_fn,
+                        in_shardings=(self._params_sharding,
+                                      self._batched_sharding),
+                        out_shardings=self._batched_sharding)
+                else:
+                    plain = jax.jit(apply_fn)
+                fam = _Family(family, plain,
+                              jax.jit(_make_gather_apply(apply_fn)),
+                              self._classes)
+                self._families[family] = fam
+            pol = _Policy(policy_id, family, params, version,
+                          row=len(fam.policies))
+            fam.policies.append(pol)
+            fam.dirty = True
+            fam.warm_gather.clear()
+            self._policies[policy_id] = pol
+            n_tenants = len(self._policies)
+        self._obs.gauge("serve_tenants", float(n_tenants))
+        prio = min(max(int(priority), 0), self._classes - 1)
+        return TenantClient(self, policy_id, prio)
+
+    def update_params(self, policy_id: str, params: Any,
+                      version: int) -> None:
+        with self._lock:
+            pol = self._policies[policy_id]
+            pol.params = params
+            pol.version = version
+            # values changed, shapes did not: the stacked cache must
+            # rebuild, the warm-bucket memos stay valid
+            self._families[pol.family].dirty = True
+
+    def policy_version(self, policy_id: str) -> int:
+        with self._lock:
+            return self._policies[policy_id].version
+
+    def warmup(self, policy_id: str, example_input: Any,
+               extra_sizes: tuple[int, ...] = ()) -> None:
+        """AOT-compile this tenant's family at every bucket size a
+        request can land in, deduped against the family's warm sets —
+        re-warming after an epoch bump or for a same-family sibling
+        tenant costs nothing. Warms the plain path always and the
+        coalesced path once the family has >1 tenant (its stack shape
+        includes the tenant count, so warm AFTER registering all
+        same-family tenants)."""
+        with self._lock:
+            pol = self._policies[policy_id]
+            fam = self._families[pol.family]
+            params = pol.params
+            n_pols = len(fam.policies)
+            stacked = (self._stacked_locked(fam)
+                       if self._coalesce and n_pols > 1 else None)
+        sizes = _pow2_bucket_sizes(self._bucket, self._max_batch,
+                                   extra_sizes)
+        for b in sorted(sizes - fam.warm_plain):
+            zeros = _zeros_like_batch(example_input, b)
+            if self._batched_sharding is not None:
+                zeros = jax.device_put(zeros, self._batched_sharding)
+            fam.apply_plain.lower(params, zeros).compile()
+            fam.warm_plain.add(b)
+        if self._coalesce and n_pols > 1:
+            for b in sorted(sizes - fam.warm_gather):
+                zeros = _zeros_like_batch(example_input, b)
+                rows = np.zeros(b, np.int32)
+                fam.apply_gather.lower(stacked, rows, zeros).compile()
+                fam.warm_gather.add(b)
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, policy_id: str, priority: int, inputs: Any,
+               n: int = 0) -> _ServeRequest:
+        prio = min(max(int(priority), 0), self._classes - 1)
+        req = _ServeRequest(policy_id, prio, inputs, n)
+        self._q.put(req)
+        return req
+
+    # -- admission controller ----------------------------------------------
+
+    def _admit_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            try:
+                r = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            with self._lock:
+                pol = self._policies.get(r.policy)
+            if pol is None:
+                r.result = KeyError(
+                    f"unknown policy {r.policy!r}: not registered "
+                    f"with this serving tier")
+                r.event.set()
+                continue
+            shed: list[_ServeRequest] = []
+            with self._lock:
+                fam = self._families[pol.family]
+                fam.pending[r.prio].append(r)
+                fam.pending_items += r.items
+                self._pending_items += r.items
+                pol.pending_items += r.items
+                pol.offered += 1
+                self._offered += 1
+                if self._pending_items > self._slo_items:
+                    shed = self._shed_locked()
+                transition = self._bp_transition_locked(bool(shed))
+                depth = self._pending_items
+            self._obs.count("serve_offered", 1)
+            for s in shed:
+                s.result = ServeShed(s.policy, s.prio)
+                s.event.set()
+                self._obs.count("serve_shed", 1)
+            self._obs.gauge("serve_queue_items", float(depth))
+            if transition is not None:
+                self._fire_backpressure(transition)
+            self._work.set()
+
+    def _shed_locked(self) -> list[_ServeRequest]:
+        """Shed newest-first from the lowest priority class until the
+        pending depth is back under the SLO line. Class 0 is never
+        shed: under pure top-class overload the queue stays deep and
+        backpressure is the only relief valve."""
+        shed: list[_ServeRequest] = []
+        for cls in range(self._classes - 1, 0, -1):
+            for fam in self._families.values():
+                dq = fam.pending[cls]
+                while dq and self._pending_items > self._slo_items:
+                    r = dq.pop()
+                    fam.pending_items -= r.items
+                    self._pending_items -= r.items  # apexlint: unguarded(caller holds _lock)
+                    pol = self._policies[r.policy]
+                    pol.pending_items -= r.items
+                    pol.shed += 1
+                    self._shed_by_class[cls] += 1  # apexlint: unguarded(caller holds _lock)
+                    shed.append(r)
+            if self._pending_items <= self._slo_items:
+                break
+        return shed
+
+    def _bp_transition_locked(self, shed_now: bool) -> bool | None:
+        """Hysteresis on the backpressure signal: engage when depth
+        crosses the SLO line (or shedding fired), release only once
+        the queue drains to half the line. Returns the new state on a
+        transition, None otherwise."""
+        depth = self._pending_items
+        if not self._bp_engaged and (shed_now
+                                     or depth > self._slo_items):
+            self._bp_engaged = True  # apexlint: unguarded(caller holds _lock)
+            return True
+        if self._bp_engaged and depth <= self._slo_items // 2:
+            self._bp_engaged = False  # apexlint: unguarded(caller holds _lock)
+            return False
+        return None
+
+    def _fire_backpressure(self, engaged: bool) -> None:
+        self._obs.gauge("serve_backpressure", 1.0 if engaged else 0.0)
+        cb = self.on_backpressure
+        if cb is not None:
+            cb(engaged)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            picked = self._take_batch()
+            if picked is None:
+                self._work.wait(timeout=0.005)
+                self._work.clear()
+                self._obs.beat("inference-server", "idle")
+                self._maybe_publish_stats()
+                continue
+            fam, reqs, items = picked
+            try:
+                self._forward(fam, reqs, items)
+            except Exception as e:  # propagate to callers, keep serving
+                for r in reqs:
+                    r.result = e
+                    r.event.set()
+            self._maybe_publish_stats()
+
+    def _take_batch(self) -> tuple[_Family, list[_ServeRequest],
+                                   int] | None:
+        """Pick the family whose head-of-queue request is most urgent
+        (highest class, then oldest) and build a batch from its
+        pending deques, class 0 first, FIFO within a class, parking
+        non-fitting requests in place (no head-of-line blocking).
+        Dispatches immediately on a full batch; otherwise waits out
+        the batching deadline from the oldest pending admit."""
+        now = time.perf_counter()
+        expired: list[_ServeRequest] = []
+        batch: tuple[_Family, list[_ServeRequest], int] | None = None
+        transition: bool | None = None
+        with self._lock:
+            expired = self._sweep_expired_locked(now)
+            best: tuple[int, float, _Family] | None = None
+            for fam in self._families.values():
+                for cls, dq in enumerate(fam.pending):
+                    if dq:
+                        if (best is None
+                                or (cls, dq[0].t_enq) < best[:2]):
+                            best = (cls, dq[0].t_enq, fam)
+                        break
+            if best is not None:
+                fam = best[2]
+                oldest = min(dq[0].t_enq
+                             for dq in fam.pending if dq)
+                if (fam.pending_items >= self._max_batch
+                        or now - oldest >= self._deadline_s
+                        or self._stop_evt.is_set()):
+                    reqs: list[_ServeRequest] = []
+                    items = 0
+                    for dq in fam.pending:
+                        kept: deque[_ServeRequest] = deque()
+                        while dq:
+                            r = dq.popleft()
+                            if (items + r.items <= self._max_batch
+                                    or (not reqs
+                                        and r.items >= self._max_batch)):
+                                reqs.append(r)
+                                items += r.items
+                            else:
+                                kept.append(r)
+                        dq.extend(kept)
+                        if items >= self._max_batch:
+                            break
+                    fam.pending_items -= items
+                    self._pending_items -= items
+                    for r in reqs:
+                        pol = self._policies[r.policy]
+                        pol.pending_items -= r.items
+                        pol.admitted += 1
+                    self._admitted += len(reqs)
+                    batch = (fam, reqs, items)
+            if expired or batch:
+                transition = self._bp_transition_locked(False)
+        for r in expired:
+            r.result = ServeDeadlineExceeded(
+                r.policy, r.prio, (now - r.t_enq) * 1e3)
+            r.event.set()
+            self._obs.count("serve_expired", 1)
+            self._obs.count("serve_shed", 1)
+        if batch is not None:
+            self._obs.count("serve_admitted", len(batch[1]))
+        if transition is not None:
+            self._fire_backpressure(transition)
+        return batch
+
+    def _sweep_expired_locked(self, now: float) -> list[_ServeRequest]:
+        """Deadline-aware shedding: pending deques are FIFO, so the
+        expired requests are exactly the stale heads."""
+        if self._req_deadline_s <= 0:
+            return []
+        expired: list[_ServeRequest] = []
+        for fam in self._families.values():
+            for cls, dq in enumerate(fam.pending):
+                while dq and now - dq[0].t_enq > self._req_deadline_s:
+                    r = dq.popleft()
+                    fam.pending_items -= r.items
+                    self._pending_items -= r.items  # apexlint: unguarded(caller holds _lock)
+                    pol = self._policies[r.policy]
+                    pol.pending_items -= r.items
+                    pol.shed += 1
+                    self._shed_by_class[cls] += 1  # apexlint: unguarded(caller holds _lock)
+                    self._expired += 1  # apexlint: unguarded(caller holds _lock)
+                    expired.append(r)
+        return expired
+
+    def _bucket(self, n: int) -> int:
+        b = next_pow2(max(n, 1))
+        if b % self._min_bucket:
+            b = -(-b // self._min_bucket) * self._min_bucket
+        return b
+
+    def _stacked_locked(self, fam: _Family) -> Any:
+        """(Re)build the family's stacked param cache if a tenant
+        registered or published since the last forward. One jnp.stack
+        per leaf per publication — never per batch. Caller holds
+        _lock; update_params contention is publication-rate, so the
+        device work under the lock is bounded and rare."""
+        if fam.dirty:
+            if len(fam.policies) == 1:
+                fam.stacked = jax.tree.map(
+                    lambda x: jnp.asarray(x)[None],
+                    fam.policies[0].params)
+            else:
+                fam.stacked = jax.tree.map(
+                    lambda *xs: jnp.stack(
+                        [jnp.asarray(x) for x in xs]),
+                    *[p.params for p in fam.policies])
+            fam.dirty = False
+        return fam.stacked
+
+    def _forward(self, fam: _Family, reqs: list[_ServeRequest],
+                 items: int) -> None:
+        padded = self._bucket(items)
+        with self._obs.span("server.batch", items=items,
+                            padded=padded):
+            leads = [r.inputs if r.n else
+                     jax.tree.map(lambda x: np.asarray(x)[None],
+                                  r.inputs)
+                     for r in reqs]
+            stacked = jax.tree.map(
+                lambda *xs: _pad_concat(xs, padded), *leads)
+            with self._lock:
+                pols = [self._policies[r.policy] for r in reqs]
+                version = max(p.version for p in pols)
+                single = len({p.policy_id for p in pols}) == 1
+                if single or not self._coalesce:
+                    params = pols[0].params
+                    stacked_params = None
+                else:
+                    params = None
+                    stacked_params = self._stacked_locked(fam)
+            if stacked_params is None:
+                # single-tenant batch: plain (optionally mesh-sharded)
+                # forward — identical to BatchedInferenceServer
+                if self._batched_sharding is not None:
+                    stacked = jax.device_put(stacked,
+                                             self._batched_sharding)
+                out = fam.apply_plain(params, stacked)
+            else:
+                # mixed tenants: one gather-indexed forward; padding
+                # rows point at row 0 and compute discarded garbage
+                rows = np.zeros(padded, np.int32)
+                off = 0
+                for r, p in zip(reqs, pols):
+                    rows[off:off + r.items] = p.row
+                    off += r.items
+                out = fam.apply_gather(stacked_params, rows, stacked)
+            out_np = jax.tree.map(np.asarray, out)
+        off = 0
+        t_done = time.perf_counter()
+        for r, p in zip(reqs, pols):
+            if r.n:
+                lo, hi = off, off + r.n
+                r.result = jax.tree.map(lambda x: x[lo:hi], out_np)
+            else:
+                idx = off
+                r.result = jax.tree.map(lambda x: x[idx], out_np)
+            off += r.items
+            lat_ms = (t_done - r.t_enq) * 1e3
+            self._obs.observe("infer_latency_ms", lat_ms)
+            p.lat_ms.append(lat_ms)
+            r.event.set()
+        with self._lock:
+            self._batches_served += 1
+            self._items_served += items
+            depth = self._pending_items
+        self._obs.on_server_batch(items, version,
+                                  depth + self._q.qsize())
+
+    def _maybe_publish_stats(self) -> None:
+        """Per-tenant serve/<tenant>/ gauges at stats cadence: p50/p99
+        of the latency reservoir, pending depth, offered/admitted/shed
+        counts. Dynamic keys by design (same policy as learn/<tenant>/
+        — the report regroups them; apexlint cross-references only
+        literal names)."""
+        now = time.monotonic()
+        if now - self._stats_last < self._stats_every_s:
+            return
+        self._stats_last = now
+        with self._lock:
+            snap = [(p.policy_id, list(p.lat_ms), p.pending_items,
+                     p.offered, p.admitted, p.shed)
+                    for p in self._policies.values()]
+        for pid, lats, depth, offered, admitted, shed in snap:
+            if lats:
+                q50, q99 = np.percentile(np.asarray(lats), (50, 99))
+                self._obs.gauge(f"serve/{pid}/p50_ms", float(q50))
+                self._obs.gauge(f"serve/{pid}/p99_ms", float(q99))
+            self._obs.gauge(f"serve/{pid}/queue_depth", float(depth))
+            self._obs.gauge(f"serve/{pid}/offered", float(offered))
+            self._obs.gauge(f"serve/{pid}/admitted", float(admitted))
+            self._obs.gauge(f"serve/{pid}/shed", float(shed))
+
+    # -- aggregate surface -------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            pending = self._pending_items
+        return pending + self._q.qsize()
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return {"offered": self._offered,
+                    "admitted": self._admitted,
+                    "shed": sum(self._shed_by_class),
+                    "shed_by_class": list(self._shed_by_class),
+                    "expired": self._expired,
+                    "batches": self._batches_served,
+                    "items": self._items_served,
+                    "avg_batch": (self._items_served
+                                  / max(self._batches_served, 1)),
+                    "tenants": len(self._policies)}
+
+    def tenant_stats(self, policy_id: str) -> dict:
+        with self._lock:
+            pol = self._policies[policy_id]
+            lats = list(pol.lat_ms)
+            out = {"offered": pol.offered, "admitted": pol.admitted,
+                   "shed": pol.shed, "pending": pol.pending_items,
+                   "version": pol.version}
+        if lats:
+            q50, q99 = np.percentile(np.asarray(lats), (50, 99))
+            out["p50_ms"], out["p99_ms"] = float(q50), float(q99)
+        return out
+
+    def stop(self) -> None:
+        if self._stop_evt.is_set():
+            return
+        self._stop_evt.set()
+        self._work.set()
+        self._admit_thread.join(timeout=5)
+        self._dispatch_thread.join(timeout=5)
+        # unblock anyone still waiting: queued and pending requests
+        # fail loudly instead of hitting their full client timeout
+        leftovers: list[_ServeRequest] = []
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        with self._lock:
+            for fam in self._families.values():
+                for dq in fam.pending:
+                    leftovers.extend(dq)
+                    dq.clear()
+                fam.pending_items = 0
+            self._pending_items = 0
+        for r in leftovers:
+            if not r.event.is_set():
+                r.result = RuntimeError("serving tier stopped")
+                r.event.set()
+
+
+def _zeros_like_batch(example_input: Any, b: int) -> Any:
+    return jax.tree.map(
+        lambda x: np.zeros((b, *np.asarray(x).shape),
+                           np.asarray(x).dtype), example_input)
+
+
+def build_serving_tier(serving: Any, *, max_batch: int,
+                       deadline_ms: float, mesh: Mesh | None = None,
+                       obs: Any = None) -> MultiPolicyInferenceServer:
+    """Construct the serving tier from a configs.ServingConfig — the
+    single place every serving knob is consumed, so drivers and actor
+    hosts stay one-call sites."""
+    return MultiPolicyInferenceServer(
+        max_batch=max_batch, deadline_ms=deadline_ms, mesh=mesh,
+        obs=obs,
+        priority_classes=serving.priority_classes,
+        queue_slo_items=serving.queue_slo_items,
+        request_deadline_ms=serving.request_deadline_ms,
+        stats_every_s=serving.stats_every_s,
+        coalesce=serving.coalesce)

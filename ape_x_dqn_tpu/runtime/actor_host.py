@@ -29,7 +29,8 @@ from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.obs.core import build_obs
 from ape_x_dqn_tpu.obs.fleet import StampingTransport, TelemetryEmitter
-from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
+from ape_x_dqn_tpu.parallel.inference_server import (
+    BatchedInferenceServer, build_serving_tier)
 from ape_x_dqn_tpu.runtime.family import (
     actor_class, family_of, server_apply_fn, warmup_example)
 from ape_x_dqn_tpu.utils.metrics import Metrics
@@ -81,11 +82,18 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     stop_event = stop_event or threading.Event()
     peer = peer_id or default_peer_id(actor_offset)
     comm = cfg.comm
+    serving = cfg.serving
     transport = SocketTransport(
         host, port, wire_codec=comm.wire_codec,
         reconnect_base_s=getattr(comm, "reconnect_base_s", 0.05),
         reconnect_cap_s=getattr(comm, "reconnect_cap_s", 2.0),
-        params_push=getattr(comm, "params_push", False))
+        params_push=getattr(comm, "params_push", False),
+        serve_policy=(cfg.env.id if serving.multi_tenant else ""),
+        serve_class=serving.default_class)
+    # the raw socket transport, before any StampingTransport wrap: the
+    # serving tier's backpressure callback must reach the object that
+    # owns send_experience's drop gate
+    raw_transport = transport
     # local obs: metrics stay in-memory (the learner's JSONL is the
     # run's single artifact; this host's view crosses the wire as
     # telemetry frames), and a trace path gets a per-peer suffix so
@@ -120,11 +128,25 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     # server protocol, actor class, and warmup example must all match
     # what the learner host's published params expect
     family = family_of(cfg)
-    server = BatchedInferenceServer(
-        server_apply_fn(family, net), params,
-        max_batch=cfg.inference.max_batch,
-        deadline_ms=cfg.inference.deadline_ms,
-        obs=obs if obs.enabled else None)
+    if serving.multi_tenant:
+        # multi-tenant serving tier: this host's policy registers under
+        # env.id; the tier's admission controller pushes backpressure
+        # into the transport's drop gate when the queue crosses the SLO
+        tier = build_serving_tier(
+            serving, max_batch=cfg.inference.max_batch,
+            deadline_ms=cfg.inference.deadline_ms,
+            obs=obs if obs.enabled else None)
+        if serving.backpressure:
+            tier.on_backpressure = raw_transport.set_backpressure
+        server = tier.register_policy(
+            cfg.env.id, server_apply_fn(family, net), params,
+            family=family, priority=serving.default_class)
+    else:
+        server = BatchedInferenceServer(
+            server_apply_fn(family, net), params,
+            max_batch=cfg.inference.max_batch,
+            deadline_ms=cfg.inference.deadline_ms,
+            obs=obs if obs.enabled else None)
     server.update_params(params, version)
     if emitter is not None:
         emitter.start()
@@ -251,6 +273,7 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
             "last_param_version": server.params_version,
             "peer_id": peer,
             "telemetry_negotiated": transport.telemetry_negotiated,
+            "serve_negotiated": raw_transport.serve_negotiated,
             "telemetry_frames_out": transport.telemetry_frames_out}
 
 

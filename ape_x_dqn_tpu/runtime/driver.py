@@ -38,7 +38,8 @@ from ape_x_dqn_tpu.obs.fleet import MAX_SPAN_IDS, FleetAggregator
 from ape_x_dqn_tpu.obs.health import make_lock
 from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
-from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
+from ape_x_dqn_tpu.parallel.inference_server import (
+    BatchedInferenceServer, MultiPolicyInferenceServer, build_serving_tier)
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
 from ape_x_dqn_tpu.replay.cold_store import ColdStore
 from ape_x_dqn_tpu.replay.frame_ring import FrameRingReplay
@@ -176,14 +177,34 @@ class ApexDriver:
             # its first forward after an ingest raises "Array has been
             # deleted" on TPU. publish_params copies.
             server_params = self.learner.publish_params(self.state)
-        self.server = BatchedInferenceServer(
-            self._server_apply_fn(),
-            server_params,
-            max_batch=cfg.inference.max_batch,
-            deadline_ms=cfg.inference.deadline_ms,
-            mesh=self.mesh if (self.is_dist
-                               and cfg.inference.shard_over_mesh) else None,
-            obs=self.obs)
+        server_mesh = self.mesh if (self.is_dist
+                                    and cfg.inference.shard_over_mesh) \
+            else None
+        # cfg.serving.multi_tenant swaps the single-policy server for the
+        # serving tier; this driver's policy registers under env.id and
+        # self.server stays signature-compatible (a TenantClient), so the
+        # actor/eval/param-publish paths below are tenancy-oblivious.
+        # Co-tenants (rotation heads, eval policies) register into
+        # self.serving alongside it.
+        self.serving: MultiPolicyInferenceServer | None = None
+        if cfg.serving.multi_tenant:
+            self.serving = build_serving_tier(
+                cfg.serving,
+                max_batch=cfg.inference.max_batch,
+                deadline_ms=cfg.inference.deadline_ms,
+                mesh=server_mesh,
+                obs=self.obs)
+            self.server = self.serving.register_policy(
+                cfg.env.id, self._server_apply_fn(), server_params,
+                family=self.family, priority=cfg.serving.default_class)
+        else:
+            self.server = BatchedInferenceServer(
+                self._server_apply_fn(),
+                server_params,
+                max_batch=cfg.inference.max_batch,
+                deadline_ms=cfg.inference.deadline_ms,
+                mesh=server_mesh,
+                obs=self.obs)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
         # fleet telemetry plane (obs/fleet.py): with obs on and a

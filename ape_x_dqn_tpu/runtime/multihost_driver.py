@@ -62,7 +62,8 @@ from ape_x_dqn_tpu.envs import make_env
 from ape_x_dqn_tpu.models import build_network
 from ape_x_dqn_tpu.parallel.dist_learner import (
     DistDQNLearner, DistSequenceLearner)
-from ape_x_dqn_tpu.parallel.inference_server import BatchedInferenceServer
+from ape_x_dqn_tpu.parallel.inference_server import (
+    BatchedInferenceServer, build_serving_tier)
 from ape_x_dqn_tpu.parallel.mesh import make_mesh
 from ape_x_dqn_tpu.parallel import multihost
 from ape_x_dqn_tpu.runtime.driver import build_prioritized_replay
@@ -207,11 +208,26 @@ class MultihostApexDriver:
             make_mesh(dp=len(local), tp=1, devices=local)
             if cfg.inference.shard_over_mesh and len(local) > 1 else None)
         server_params = self._host_params()
-        self.server = BatchedInferenceServer(
-            server_apply_fn(self.family, self.net), server_params,
-            max_batch=cfg.inference.max_batch,
-            deadline_ms=cfg.inference.deadline_ms,
-            mesh=self._inference_mesh, obs=self.obs)
+        # the serving tier stays process-local for the same reason the
+        # inference mesh does: admission/dispatch never cross hosts, so
+        # multi-tenancy cannot perturb the global lockstep
+        self.serving = None
+        if cfg.serving.multi_tenant:
+            self.serving = build_serving_tier(
+                cfg.serving,
+                max_batch=cfg.inference.max_batch,
+                deadline_ms=cfg.inference.deadline_ms,
+                mesh=self._inference_mesh, obs=self.obs)
+            self.server = self.serving.register_policy(
+                cfg.env.id, server_apply_fn(self.family, self.net),
+                server_params, family=self.family,
+                priority=cfg.serving.default_class)
+        else:
+            self.server = BatchedInferenceServer(
+                server_apply_fn(self.family, self.net), server_params,
+                max_batch=cfg.inference.max_batch,
+                deadline_ms=cfg.inference.deadline_ms,
+                mesh=self._inference_mesh, obs=self.obs)
         self.transport = transport if transport is not None \
             else LoopbackTransport()
         # fleet telemetry (obs/fleet.py): merge remote actor hosts'

@@ -1,0 +1,12 @@
+"""obs-names fixture: the two ways a serving-tier PR drifts.
+
+`serve_queue_items` is emitted as a counter while the table lists a
+gauge (the report would look under ctr/ and never print the depth);
+`serve_preempted` has no row at all (the report silently drops a new
+admission outcome).
+"""
+
+
+def admit(obs, depth):
+    obs.count("serve_queue_items", depth)  # kind mismatch
+    obs.count("serve_preempted", 1)  # no INSTRUMENTS row, no waiver

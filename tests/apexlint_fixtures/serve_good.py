@@ -1,0 +1,36 @@
+"""obs-names fixture: the serving-tier emission shape.
+
+Mirrors parallel/inference_server.py's MultiPolicyInferenceServer
+literal emission sites: admission-controller counters, tier-level
+gauges, and the shared infer_latency_ms histogram — every one carries
+a row in the serve report fixture with the kind the registry
+publishes it under. The per-tenant stats ride dynamic
+`serve/<tenant>/<stat>` f-string keys and are invisible to the
+checker by design (same policy as the learning plane's learn/ keys
+and the fleet plane's peer/ keys).
+"""
+
+
+def admit(obs, depth, shed_n):
+    obs.count("serve_offered", 1)
+    for _ in range(shed_n):
+        obs.count("serve_shed", 1)
+    obs.gauge("serve_queue_items", float(depth))
+
+
+def dispatch(obs, n_admitted, n_expired, lat_ms):
+    obs.count("serve_admitted", n_admitted)
+    for _ in range(n_expired):
+        obs.count("serve_expired", 1)
+        obs.count("serve_shed", 1)
+    obs.observe("infer_latency_ms", lat_ms)
+
+
+def publish_tier(obs, n_tenants, engaged):
+    obs.gauge("serve_tenants", float(n_tenants))
+    obs.gauge("serve_backpressure", 1.0 if engaged else 0.0)
+
+
+def publish_tenant(obs, pid, stats):
+    for k, v in stats.items():
+        obs.gauge(f"serve/{pid}/{k}", v)

@@ -1,0 +1,17 @@
+"""obs-names fixture: mini INSTRUMENTS table for the serving tier.
+
+Rows match serve_good.py's emissions; `serve_queue_items` is listed
+as a gauge so serve_bad.py's counter emission is a kind-mismatch
+finding.
+"""
+
+INSTRUMENTS = {
+    "serve_offered": {"kind": "ctr"},
+    "serve_admitted": {"kind": "ctr"},
+    "serve_shed": {"kind": "ctr"},
+    "serve_expired": {"kind": "ctr"},
+    "serve_tenants": {"kind": "gauge"},
+    "serve_backpressure": {"kind": "gauge"},
+    "serve_queue_items": {"kind": "gauge"},
+    "infer_latency_ms": {"kind": "hist"},
+}

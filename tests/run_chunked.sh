@@ -101,6 +101,20 @@ if ! python bench.py --tiered-ab --smoke --perf-gate; then
     failed_files+=("bench.py --tiered-ab --smoke")
 fi
 
+# Serving-tier smoke: the multi-tenant A/B + 2x-overload shedding
+# phase (parallel/inference_server.py serving tier). The lane's own
+# criteria are hard (multi/single >= 0.9 both orders pooled, top-class
+# p99 inside the INSTRUMENTS healthy range, class-0 shed == 0,
+# accounting closure), and --perf-gate anti-ratchets aggregate
+# forwards/s against the last comparable (same tenants/max_batch/
+# vector/smoke class) SERVE_SMOKE.json; failing runs never reseed.
+echo
+echo "=== bench.py --serve-ab --smoke"
+if ! python bench.py --serve-ab --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --serve-ab --smoke")
+fi
+
 echo
 if [ "${fail}" -ne 0 ]; then
     echo "FAILED files: ${failed_files[*]}"

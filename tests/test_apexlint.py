@@ -404,6 +404,53 @@ def test_obs_names_cold_fixtures():
     assert len(bad.findings) == 2
 
 
+def test_obs_names_serve_fixtures():
+    """The serving-tier fixture pair (ISSUE 13): the good emitter's
+    admission counters + tier gauges + latency histogram
+    cross-reference cleanly (per-tenant serve/<tenant>/ f-string keys
+    invisible by design); the bad emitter drifts both ways (queue
+    depth emitted as a counter, an unlisted admission-outcome
+    counter)."""
+    report = _fx("serve_report_fixture.py")
+    good = obs_names.check([_fx("serve_good.py")], report)
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = obs_names.check(
+        [_fx("serve_good.py"), _fx("serve_bad.py")], report)
+    msgs = [f.message for f in bad.findings]
+    assert any("serve_queue_items" in m for m in msgs)  # gauge-vs-ctr
+    assert any("serve_preempted" in m for m in msgs)  # unlisted
+    assert len(bad.findings) == 2
+
+
+def test_config_coverage_serving_scope(tmp_path):
+    """ServingConfig is in the README-knob scope (ISSUE 13): a README
+    naming a nonexistent serving.<knob> fails, a real knob passes, and
+    an unread ServingConfig field fails direction 1."""
+    from tools.apexlint import config_coverage
+
+    configs = tmp_path / "configs.py"
+    configs.write_text(
+        "from dataclasses import dataclass\n"
+        "@dataclass\nclass ServingConfig:\n"
+        "    multi_tenant: bool = False\n"
+        "    dead_knob: int = 0\n")
+    reader = tmp_path / "reader.py"
+    reader.write_text("def f(cfg):\n    return cfg.multi_tenant\n")
+    readme = tmp_path / "README.md"
+    readme.write_text("set serving.multi_tenant, not "
+                      "serving.imaginary_knob\n")
+    res = config_coverage.check(
+        [str(configs), str(reader)], configs_path=str(configs),
+        readme_path=str(readme))
+    msgs = [f.message for f in res.findings]
+    assert any("serving.imaginary_knob" in m for m in msgs)
+    assert any("ServingConfig.dead_knob" in m for m in msgs)
+    assert not any("multi_tenant" in m for m in msgs)
+    assert len(res.findings) == 2
+
+
 def test_obs_names_kind_mismatch(tmp_path):
     emit = tmp_path / "emit.py"
     emit.write_text("def f(obs):\n    obs.gauge('x_name', 1)\n")

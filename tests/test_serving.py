@@ -1,0 +1,460 @@
+"""Multi-tenant serving tier (ISSUE 13): continuous-batching admission,
+priority load-shedding, coalesced forwards, and the tagged-request wire
+interop — plus the BatchedInferenceServer satellite fixes (head-of-line
+collection, warm-bucket dedupe across epochs)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ape_x_dqn_tpu.parallel.inference_server import (
+    BatchedInferenceServer, MultiPolicyInferenceServer,
+    ServeDeadlineExceeded, ServeShed, _Request, build_serving_tier)
+
+
+def _scale_apply(params, x):
+    return x * params["w"]
+
+
+def _w(v):
+    return {"w": np.float32(v)}
+
+
+# -- satellite 1: _collect head-of-line fix ---------------------------------
+
+
+class _NoServe(BatchedInferenceServer):
+    """Server whose serve thread exits immediately: the queue and the
+    held deque are driven by calling _collect directly, so collection
+    semantics are testable without racing a consumer."""
+
+    def _serve_loop(self):
+        return
+
+
+def test_collect_oversize_does_not_starve_fitting_requests():
+    """Regression (ISSUE 13 satellite): a held-back K-item vector
+    request must not block smaller requests that still fit the current
+    bucket — they keep admitting around it, and the vector serves in
+    the NEXT batch, alone, in arrival order."""
+    server = _NoServe(_scale_apply, _w(1.0), max_batch=8, deadline_ms=1.0)
+    try:
+        singles_a = [_Request(np.zeros(2, np.float32)) for _ in range(4)]
+        vector = _Request(np.zeros((6, 2), np.float32), n=6)
+        singles_b = [_Request(np.zeros(2, np.float32)) for _ in range(4)]
+        for r in [*singles_a, vector, *singles_b]:
+            server._q.put(r)
+        first = server._collect()
+        # 4 singles, the 6-item vector is parked (4+6 > 8), then the
+        # remaining 4 singles fill the batch to exactly max_batch
+        assert first == [*singles_a, *singles_b]
+        assert sum(r.items for r in first) == 8
+        second = server._collect()
+        assert second == [vector]
+    finally:
+        server.stop()
+
+
+def test_collect_oversize_request_serves_alone():
+    """A single request larger than max_batch still serves (alone, in
+    its own warmed bucket) instead of being parked forever."""
+    server = _NoServe(_scale_apply, _w(1.0), max_batch=4, deadline_ms=1.0)
+    try:
+        big = _Request(np.zeros((9, 2), np.float32), n=9)
+        small = _Request(np.zeros(2, np.float32))
+        server._q.put(big)
+        server._q.put(small)
+        first = server._collect()
+        assert first == [big]
+        assert server._collect() == [small]
+    finally:
+        server.stop()
+
+
+def test_collect_preserves_arrival_order_among_held():
+    """Parked requests re-enter in arrival order ahead of new queue
+    traffic once capacity frees."""
+    server = _NoServe(_scale_apply, _w(1.0), max_batch=4, deadline_ms=1.0)
+    try:
+        v1 = _Request(np.zeros((3, 2), np.float32), n=3)
+        v2 = _Request(np.zeros((3, 2), np.float32), n=3)
+        v3 = _Request(np.zeros((3, 2), np.float32), n=3)
+        for r in (v1, v2, v3):
+            server._q.put(r)
+        assert server._collect() == [v1]  # v2/v3 parked (3+3 > 4)
+        assert server._collect() == [v2]
+        assert server._collect() == [v3]
+    finally:
+        server.stop()
+
+
+# -- satellite 2: warm-bucket dedupe across epochs --------------------------
+
+
+def test_warmup_dedupes_across_update_params_epochs():
+    """An epoch bump changes param VALUES, not shapes: re-warming after
+    update_params must re-pay zero AOT compiles (asserted via the
+    compile-telemetry delta, PR 8)."""
+    from ape_x_dqn_tpu.obs.profiling import CompileWatcher
+
+    watcher = CompileWatcher.install()
+    server = BatchedInferenceServer(_scale_apply, _w(1.0),
+                                    max_batch=8, deadline_ms=1.0)
+    try:
+        example = np.zeros(3, np.float32)
+        server.warmup(example, extra_sizes=(5,))
+        warm, _ = watcher.snapshot()
+        assert warm > 0  # the first warmup really compiled
+        server.update_params(_w(2.0), 1)
+        server.warmup(example, extra_sizes=(5,))
+        again, _ = watcher.snapshot()
+        assert again == warm, "epoch bump re-paid AOT compiles"
+        # a NEW bucket size still compiles exactly that bucket
+        server.warmup(example, extra_sizes=(3 * 8,))
+        grown, _ = watcher.snapshot()
+        assert grown > again
+        out = server.query(np.full(3, 2.0, np.float32))
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+    finally:
+        server.stop()
+
+
+# -- admission semantics ----------------------------------------------------
+
+
+class _NoDispatch(MultiPolicyInferenceServer):
+    """Tier whose dispatch thread exits immediately: the REAL admission
+    thread runs (offer/shed/backpressure accounting), while batches are
+    taken synchronously from the test via _take_batch — deterministic
+    under saturation."""
+
+    def _dispatch_loop(self):
+        return
+
+
+def _wait_depth(tier, depth, timeout=5.0):
+    """Wait until admission has drained the intake queue into the
+    pending deques and the pending depth reads `depth`."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if tier._q.qsize() == 0 and tier.queue_depth == depth:
+            return
+        time.sleep(0.002)
+    raise AssertionError(
+        f"queue never reached depth {depth}: at {tier.queue_depth}")
+
+
+def test_priority_ordering_under_saturation():
+    """With more pending than one batch holds, class 0 is served first
+    (FIFO within the class); lower classes fill the remainder oldest
+    first. deadline_ms=0 makes _take_batch dispatch unconditionally."""
+    tier = _NoDispatch(max_batch=4, deadline_ms=0.0,
+                       priority_classes=3, queue_slo_items=100)
+    try:
+        c = [tier.register_policy(f"p{i}", _scale_apply, _w(i + 1),
+                                  family="mlp", priority=i)
+             for i in range(3)]
+        x = np.zeros(2, np.float32)
+        low = [c[2].submit(x) for _ in range(4)]
+        mid = [c[1].submit(x) for _ in range(2)]
+        top = [c[0].submit(x) for _ in range(2)]
+        _wait_depth(tier, 8)
+        fam, reqs, items = tier._take_batch()
+        assert items == 4
+        # both class-0 requests, then both class-1, before any class-2
+        assert [r.policy for r in reqs] == ["p0", "p0", "p1", "p1"]
+        fam, reqs, items = tier._take_batch()
+        assert [r.policy for r in reqs] == ["p2"] * 4
+        assert [id(r) for r in reqs] == [id(r) for r in low]  # FIFO
+        del mid, top
+    finally:
+        tier.stop()
+
+
+def test_shed_accounting_closure_and_class_protection():
+    """Overload sheds newest-first from the LOWEST class only, class 0
+    is never shed, and the books close: offered == admitted +
+    sum(shed_by_class) once the queue is drained."""
+    tier = _NoDispatch(max_batch=4, deadline_ms=0.0,
+                       priority_classes=3, queue_slo_items=6)
+    try:
+        c = [tier.register_policy(f"p{i}", _scale_apply, _w(i + 1),
+                                  family="mlp", priority=i)
+             for i in range(3)]
+        x = np.zeros(2, np.float32)
+        tickets = []
+        for _ in range(5):
+            tickets.append(c[0].submit(x))
+        for _ in range(5):
+            tickets.append(c[1].submit(x))
+        for _ in range(5):
+            tickets.append(c[2].submit(x))
+        deadline = time.monotonic() + 5.0
+        while tier._q.qsize() and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert tier.queue_depth <= 6  # controller held the SLO line
+        shed_errs = []
+        for t in tickets:
+            if t.event.is_set() and isinstance(t.result, ServeShed):
+                shed_errs.append(t.result)
+        assert shed_errs, "2.5x-SLO offered load must shed"
+        assert all(e.priority > 0 for e in shed_errs)  # class 0 immune
+        while tier._take_batch() is not None:
+            pass
+        s = tier.stats
+        assert s["offered"] == 15
+        assert s["shed_by_class"][0] == 0
+        assert s["offered"] == s["admitted"] + sum(s["shed_by_class"])
+        # shed errors carry the attribution the client logs
+        e = shed_errs[0]
+        assert e.policy_id in ("p1", "p2")
+    finally:
+        tier.stop()
+
+
+def test_deadline_expiry_names_policy():
+    """A request idling past serving.request_deadline_ms raises an
+    attributed ServeDeadlineExceeded naming the policy and class."""
+    tier = _NoDispatch(max_batch=4, deadline_ms=0.0,
+                       priority_classes=2, queue_slo_items=100,
+                       request_deadline_ms=20.0)
+    try:
+        client = tier.register_policy("breakout", _scale_apply,
+                                      _w(1.0), priority=1)
+        ticket = client.submit(np.zeros(2, np.float32))
+        _wait_depth(tier, 1)
+        time.sleep(0.05)
+        assert tier._take_batch() is None  # the sweep, nothing to serve
+        with pytest.raises(ServeDeadlineExceeded) as ei:
+            ticket.wait(timeout=1.0)
+        assert "breakout" in str(ei.value)
+        assert "class 1" in str(ei.value)
+        s = tier.stats
+        assert s["expired"] == 1
+        assert s["offered"] == s["admitted"] + sum(s["shed_by_class"])
+    finally:
+        tier.stop()
+
+
+def test_unknown_policy_rejected_with_attribution():
+    tier = MultiPolicyInferenceServer(max_batch=4, deadline_ms=1.0)
+    try:
+        tier.register_policy("known", _scale_apply, _w(1.0))
+        ticket = tier.submit("ghost", 0, np.zeros(2, np.float32))
+        with pytest.raises(KeyError, match="ghost"):
+            ticket.wait(timeout=2.0)
+    finally:
+        tier.stop()
+
+
+def test_backpressure_hysteresis_transitions():
+    """Crossing the SLO line fires on_backpressure(True); it releases
+    only once the queue drains to half the line (hysteresis)."""
+    tier = _NoDispatch(max_batch=2, deadline_ms=0.0,
+                       priority_classes=2, queue_slo_items=6)
+    events: list[bool] = []
+    tier.on_backpressure = events.append
+    try:
+        client = tier.register_policy("p", _scale_apply, _w(1.0),
+                                      priority=1)
+        x = np.zeros(2, np.float32)
+        for _ in range(7):
+            client.submit(x)
+        deadline = time.monotonic() + 5.0
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert events == [True]
+        # draining one batch leaves depth 4 > slo//2=3: still engaged
+        assert tier._take_batch() is not None
+        assert events == [True]
+        while tier._take_batch() is not None:
+            pass
+        assert events == [True, False]
+    finally:
+        tier.stop()
+
+
+# -- coalesced multi-tenant forwards ----------------------------------------
+
+
+def test_coalesced_forward_per_tenant_params():
+    """Same-family tenants coalesce into one gather-indexed forward;
+    each request still sees ITS tenant's params, for singles and for
+    vector requests, across an update_params epoch bump."""
+    tier = MultiPolicyInferenceServer(max_batch=16, deadline_ms=2.0,
+                                      priority_classes=2)
+    try:
+        clients = [tier.register_policy(f"pol{i}", _scale_apply,
+                                        _w(i + 1), family="mlp")
+                   for i in range(8)]
+        for c in clients:
+            c.warmup(np.zeros(3, np.float32))
+        x = np.full(3, 2.0, np.float32)
+        results = [None] * 8
+
+        def ask(i):
+            results[i] = np.asarray(clients[i].query(x))
+
+        threads = [threading.Thread(target=ask, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, out in enumerate(results):
+            np.testing.assert_allclose(out, 2.0 * (i + 1), err_msg=str(i))
+        vec = np.asarray(clients[3].query_batch(
+            np.ones((5, 3), np.float32), 5))
+        assert vec.shape == (5, 3)
+        np.testing.assert_allclose(vec, 4.0)
+        clients[3].update_params(_w(100.0), version=9)
+        assert clients[3].params_version == 9
+        np.testing.assert_allclose(
+            np.asarray(clients[3].query(np.ones(3, np.float32))), 100.0)
+        assert tier.stats["tenants"] == 8
+    finally:
+        tier.stop()
+
+
+def test_build_serving_tier_reads_config():
+    from ape_x_dqn_tpu.configs import ServingConfig
+
+    scfg = ServingConfig(multi_tenant=True, priority_classes=5,
+                         queue_slo_items=32, request_deadline_ms=250.0,
+                         coalesce=False)
+    tier = build_serving_tier(scfg, max_batch=8, deadline_ms=1.0)
+    try:
+        assert tier._classes == 5
+        assert tier._slo_items == 32
+        assert tier._req_deadline_s == pytest.approx(0.25)
+        assert not tier._coalesce
+    finally:
+        tier.stop()
+
+
+def test_stop_fails_leftover_tickets():
+    tier = _NoDispatch(max_batch=4, deadline_ms=0.0,
+                       queue_slo_items=100)
+    client = tier.register_policy("p", _scale_apply, _w(1.0))
+    ticket = client.submit(np.zeros(2, np.float32))
+    _wait_depth(tier, 1)
+    tier.stop()
+    with pytest.raises(RuntimeError, match="stopped"):
+        ticket.wait(timeout=1.0)
+
+
+# -- tagged-request wire interop --------------------------------------------
+
+
+def _mini_batch():
+    return {"obs": np.zeros((2, 4), np.uint8),
+            "priorities": np.ones(2, np.float32), "actor": 0}
+
+
+def test_serve_tags_negotiated_new_client_new_server():
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport)
+
+    server = SocketIngestServer("127.0.0.1", 0)
+    client = SocketTransport("127.0.0.1", server.port,
+                             serve_policy="pong", serve_class=1)
+    try:
+        client.send_experience(_mini_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+        assert client.serve_negotiated
+        assert server.serve_peers == {"pong": 1}
+    finally:
+        client.close()
+        server.stop()
+        assert server.serve_peers == {}
+
+
+def test_serve_tags_old_client_new_server():
+    """A client that never offers a serve tag (old build / tenancy off)
+    negotiates exactly as before: no serve peers, experience flows."""
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport)
+
+    server = SocketIngestServer("127.0.0.1", 0)
+    client = SocketTransport("127.0.0.1", server.port)
+    try:
+        client.send_experience(_mini_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+        assert not client.serve_negotiated
+        assert server.serve_peers == {}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_serve_tags_new_client_old_server():
+    """An OLD server ignores MSG_HELLO entirely: the tagged client must
+    degrade (serve_negotiated False, raw codec) and its experience must
+    still arrive."""
+    import socket as socket_mod
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        MSG_EXPERIENCE, SocketTransport, _recv_msg, decode_batch)
+
+    listener = socket_mod.socket(socket_mod.AF_INET,
+                                 socket_mod.SOCK_STREAM)
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    got: list = []
+
+    def old_server():
+        conn, _ = listener.accept()
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                return
+            if msg[0] == MSG_EXPERIENCE:  # hellos silently ignored
+                got.append(msg[1])
+                return
+
+    thread = threading.Thread(target=old_server, daemon=True)
+    thread.start()
+    client = SocketTransport("127.0.0.1", listener.getsockname()[1],
+                             hello_timeout=0.3,
+                             serve_policy="pong", serve_class=0)
+    try:
+        batch = _mini_batch()
+        client.send_experience(batch)
+        assert not client.serve_negotiated
+        thread.join(timeout=5)
+        assert got, "old server never received the raw experience"
+        np.testing.assert_array_equal(decode_batch(got[0])["obs"],
+                                      batch["obs"])
+    finally:
+        client.close()
+        listener.close()
+
+
+def test_transport_backpressure_gate_drops_and_releases():
+    """set_backpressure(True) — the serving tier's SLO signal — makes
+    send_experience drop (attributed to the 'backpressure' bucket)
+    without touching the socket; release resumes delivery."""
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport)
+
+    server = SocketIngestServer("127.0.0.1", 0)
+    client = SocketTransport("127.0.0.1", server.port,
+                             serve_policy="pong")
+    try:
+        client.send_experience(_mini_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+        client.set_backpressure(True)
+        before = client.dropped
+        client.send_experience(_mini_batch())
+        client.send_experience(_mini_batch())
+        assert client.dropped == before + 2
+        assert client.drop_reasons["backpressure"] == 2
+        assert server.recv_experience(timeout=0.2) is None
+        client.set_backpressure(False)
+        client.send_experience(_mini_batch())
+        assert server.recv_experience(timeout=5.0) is not None
+    finally:
+        client.close()
+        server.stop()

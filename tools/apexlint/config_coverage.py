@@ -12,9 +12,10 @@ Both directions of config/doc drift:
    deliberately-dormant field with `# apexlint: unread(<why>)` on its
    declaration line.
 
-2. Every `replay.` / `comm.` / `obs.` / `actors.` knob mentioned in
-   README must exist as a field on the matching dataclass
-   (ReplayConfig / CommConfig / ObsConfig / ActorConfig). Mentions
+2. Every `replay.` / `comm.` / `obs.` / `actors.` / `serving.` knob
+   mentioned in README must exist as a field on the matching dataclass
+   (ReplayConfig / CommConfig / ObsConfig / ActorConfig /
+   ServingConfig). Mentions
    that name a package MODULE instead of a knob (`obs.health`,
    `obs.report` — `ape_x_dqn_tpu/obs/health.py` exists) are skipped.
 
@@ -37,8 +38,10 @@ from tools.apexlint.common import CheckResult, Finding, ModuleSource
 CHECKER = "config-coverage"
 
 PREFIX_TO_CLASS = {"replay": "ReplayConfig", "comm": "CommConfig",
-                   "obs": "ObsConfig", "actors": "ActorConfig"}
-KNOB_RE = re.compile(r"\b(replay|comm|obs|actors)\.([a-z_][a-z0-9_]*)")
+                   "obs": "ObsConfig", "actors": "ActorConfig",
+                   "serving": "ServingConfig"}
+KNOB_RE = re.compile(
+    r"\b(replay|comm|obs|actors|serving)\.([a-z_][a-z0-9_]*)")
 
 
 def _is_dataclass(cls: ast.ClassDef) -> bool:
