@@ -1377,7 +1377,12 @@ class SocketTransport:
         epoch CHANGE (new server incarnation) clears the pushed-params
         cell (it came from the dead incarnation) and warns — version
         counters may have restarted, so downstream updates must key on
-        the epoch, not on version monotonicity."""
+        the epoch, not on version monotonicity. The serving tier's
+        backpressure latch clears for the same reason: it was engaged
+        by the DEAD incarnation's admission controller, and left set it
+        would shed every send into the new incarnation forever — the
+        new controller re-engages within one SLO window if its queue
+        really is over the line."""
         with self._meta_lock:
             old = self._epoch
             self._epoch = ep
@@ -1387,10 +1392,12 @@ class SocketTransport:
         if changed:
             with self._push_lock:
                 self._pushed = None
+            self._bp_engaged = False
             logging.getLogger(__name__).warning(
                 "[fleet] learner epoch changed %d -> %d (restart or "
                 "failover); params will re-converge to the new "
-                "incarnation", old, ep)
+                "incarnation and any stale backpressure latch is "
+                "released", old, ep)
 
     def _connect_experience(self) -> socket.socket:
         """Connect the experience socket and negotiate codec, telemetry
@@ -1584,6 +1591,32 @@ class SocketTransport:
         at an over-SLO learner. Called by the admission controller's
         on_backpressure hook; thread-safe (plain bool flip)."""
         self._bp_engaged = bool(engaged)
+
+    @property
+    def backpressure_engaged(self) -> bool:
+        """Current state of the serving-tier backpressure latch (read
+        by the remediation plane's stale-controller watchdog and the
+        chaos bench's remediated arm)."""
+        return self._bp_engaged
+
+    def kick(self) -> bool:
+        """Remediation actuator: collapse the pending reconnect
+        backoff so the NEXT send retries immediately, for a supervisor
+        that has verified the learner is reachable again while this
+        sender still sits out a backoff window armed during the
+        outage. A driver-side slot restart gets this for free (a fresh
+        transport has no backoff state); kick() is the same remedy
+        without discarding the connection's negotiated codec and
+        accounting. The backoff POLICY is untouched — the next failure
+        re-arms it at the same escalation point. Returns False when no
+        backoff was pending (outcome "skipped" in the remediation
+        plane's attribution)."""
+        with self._send_lock:
+            if self._sock is not None \
+                    or time.monotonic() >= self._backoff_until:
+                return False
+            self._backoff_until = 0.0  # apexlint: unguarded(holds _send_lock)
+            return True
 
     def send_telemetry(self, frame: dict) -> bool:
         """Best-effort ship of one obs snapshot frame (MSG_TELEMETRY,

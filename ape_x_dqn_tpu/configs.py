@@ -413,6 +413,69 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class RemediationConfig:
+    """Fleet remediation plane (runtime/remediation.py): the policy
+    engine that closes the monitor->actuator loop inside the driver's
+    supervisor tick. Off by default — the engine is then never
+    constructed and the supervisor path is bitwise the pre-remediation
+    one. "observe" dry-runs every rule (attributed JSONL `remediation`
+    events with outcome=observed, counters, gauges) without ever
+    calling an actuator; "enforce" acts."""
+
+    mode: str = "off"  # off | observe | enforce
+    # consecutive supervisor ticks a gauge rule (queue-SLO breach,
+    # ingest-drop pressure) must agree before its actuator moves, and
+    # again before it moves back — a sensor flapping breach/clear every
+    # tick never accumulates a streak, so actuators cannot oscillate
+    hysteresis_ticks: int = 3
+    # event rules (peer perf degradation, tenant learning degradation)
+    # fire after this many attributed events on one target inside the
+    # sliding window — one noisy sample is not a policy decision
+    event_threshold: int = 2
+    event_window_s: float = 120.0
+    # per-(target, action) cooldown: the same remedy is not re-applied
+    # to the same target faster than this
+    cooldown_s: float = 60.0
+    # global token-bucket budget for NON-safety actions (backpressure,
+    # autoscale, priority re-temper) in actions/minute; safety actions
+    # (restart of a wedged local slot, quarantine of a stalled peer)
+    # bypass the bucket — suppressing them would leave a stale
+    # heartbeat for the watchdog to escalate into a run-fatal
+    # StallError, strictly worse than acting
+    budget_per_min: float = 6.0
+    # quiet period after which engaged remedies are unwound: a boosted
+    # tenant priority reverts to serving.default_class, a paused actor
+    # slot resumes, a client-side backpressure flag with a dead
+    # controller is released
+    release_after_s: float = 300.0
+    # autoscale floor: the ingest-pressure rule never pauses the fleet
+    # below this many running local actor slots
+    min_actors: int = 1
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("off", "observe", "enforce"):
+            raise ValueError(
+                f"remediation.mode must be off | observe | enforce "
+                f"(got {self.mode!r})")
+        if self.hysteresis_ticks < 1:
+            raise ValueError(
+                f"remediation.hysteresis_ticks must be >= 1 "
+                f"(got {self.hysteresis_ticks})")
+        if self.event_threshold < 1:
+            raise ValueError(
+                f"remediation.event_threshold must be >= 1 "
+                f"(got {self.event_threshold})")
+        if self.budget_per_min <= 0:
+            raise ValueError(
+                f"remediation.budget_per_min must be > 0 "
+                f"(got {self.budget_per_min})")
+        if self.min_actors < 0:
+            raise ValueError(
+                f"remediation.min_actors must be >= 0 "
+                f"(got {self.min_actors})")
+
+
+@dataclass(frozen=True)
 class RunConfig:
     name: str = "cartpole_smoke"
     seed: int = 0
@@ -431,6 +494,11 @@ class RunConfig:
     # observability (ape_x_dqn_tpu/obs): off by default; enable with
     # --set obs.enabled=true [--set obs.trace_path=trace.json ...]
     obs: ObsConfig = field(default_factory=ObsConfig)
+    # fleet remediation plane (runtime/remediation.py): off by default;
+    # dry-run with --set remediation.mode=observe, close the loop with
+    # --set remediation.mode=enforce
+    remediation: RemediationConfig = field(
+        default_factory=RemediationConfig)
     eval_every_steps: int = 10_000
     eval_episodes: int = 10
     eval_eps: float = 0.001

@@ -39,6 +39,7 @@ handful of fused scalar reductions whether or not anyone reads them
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any
 
@@ -225,6 +226,14 @@ class LearnMonitor:
         self._lock = make_lock("learning.learn_monitor")
         self._loss: dict[str, dict] = {}        # guarded-by: _lock
         self._last_fire: dict[tuple, float] = {}  # guarded-by: _lock
+        # fire listeners (the remediation plane subscribes here):
+        # called OUTSIDE the monitor lock, once per emitted event, with
+        # (rule, value, baseline, step, tenant). Append-only at wiring
+        # time, so iteration is safe without the lock.
+        self._listeners: list = []
+
+    def add_listener(self, cb) -> None:
+        self._listeners.append(cb)
 
     def observe(self, vals: dict, loss: float, step: int = 0,
                 tenant: str = "") -> None:
@@ -269,3 +278,10 @@ class LearnMonitor:
                 learn_tenant=tenant or None,
                 learn_value=round(value, 6),
                 learn_baseline=round(baseline, 6))
+            for cb in self._listeners:
+                try:
+                    cb(rule, value, baseline, step, tenant)
+                except Exception:  # noqa: BLE001 - warn-only plane
+                    logging.getLogger(__name__).warning(
+                        "learning-degradation listener failed",
+                        exc_info=True)

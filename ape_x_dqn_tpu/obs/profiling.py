@@ -42,6 +42,7 @@ never imports this module's jax hooks at all.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from contextlib import contextmanager
@@ -363,6 +364,14 @@ class PerfMonitor:
         self._lock = make_lock("profiling.perf_monitor")
         # (peer, name) -> {"ewma", "n", "last_fire"}
         self._series: dict[tuple[str, str], dict] = {}  # guarded-by: _lock
+        # fire listeners (the remediation plane subscribes here):
+        # called OUTSIDE the monitor lock, once per emitted event, with
+        # (name, value, baseline, step, peer). Append-only at wiring
+        # time, so iteration is safe without the lock.
+        self._listeners: list = []
+
+    def add_listener(self, cb) -> None:
+        self._listeners.append(cb)
 
     def observe(self, name: str, value: float, step: int = 0,
                 peer: str = "") -> None:
@@ -397,6 +406,13 @@ class PerfMonitor:
                 perf_value=round(value, 3),
                 perf_baseline=round(baseline, 3),
                 perf_frac=self.frac)
+            for cb in self._listeners:
+                try:
+                    cb(name, value, baseline, step, peer)
+                except Exception:  # noqa: BLE001 - warn-only plane
+                    logging.getLogger(__name__).warning(
+                        "perf-degradation listener failed",
+                        exc_info=True)
 
     def _publish_local(self, name: str, ewma: float) -> None:
         # literal emissions per tracked local rate (obs-names contract)

@@ -214,6 +214,28 @@ INSTRUMENTS = {
                  "offered load exceeds serving capacity — the "
                  "controller is shedding lower classes and "
                  "backpressuring the transport")},
+    # fleet remediation plane (runtime/remediation.py, ISSUE 14): the
+    # policy engine that closes the monitor→actuator loop. Outcome
+    # counters partition every decision: applied (actuator ran) /
+    # observed (dry-run mode) / suppressed (budget) / failed (actuator
+    # raised). remediation_mode encodes the configured mode (1=observe,
+    # 2=enforce; absent/0 = off). budget_headroom is the live token
+    # count of the global actions/min bucket — below 1.0 the engine
+    # cannot afford a single non-safety action, which is a health
+    # violation only in enforce mode (check_violations gates on the
+    # mode gauge).
+    "remediation_actions": {"kind": "ctr"},
+    "remediation_observed": {"kind": "ctr"},
+    "remediation_suppressed": {"kind": "ctr"},
+    "remediation_failed": {"kind": "ctr"},
+    "remediation_budget_headroom": {
+        "kind": "gauge",
+        "warn": ("value_min", 1.0,
+                 "action-budget headroom below one token means the "
+                 "remediation engine is rate-limited out of acting — "
+                 "faults are firing faster than "
+                 "remediation.budget_per_min allows responses")},
+    "remediation_mode": {"kind": "gauge"},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -245,6 +267,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     disconnects: list[dict] = []
     perf_events: list[dict] = []
     learn_events: list[dict] = []
+    remediation_events: list[dict] = []
     for rec in records:
         for k, v in rec.items():
             if v is not None:
@@ -270,6 +293,15 @@ def summarize(records: list[dict]) -> dict[str, Any]:
                                 "value": rec.get("perf_value"),
                                 "baseline": rec.get("perf_baseline"),
                                 "frac": rec.get("perf_frac")})
+        if rec.get("remediation") is not None:
+            remediation_events.append({
+                "step": rec.get("step"),
+                "rule": rec["remediation"],
+                "target": rec.get("remediation_target"),
+                "action": rec.get("remediation_action"),
+                "outcome": rec.get("remediation_outcome"),
+                "value": rec.get("remediation_value"),
+                "baseline": rec.get("remediation_baseline")})
     # fleet telemetry: `peer/<id>/<kind>/<name>` keys the aggregator
     # merges into the stream (obs/fleet.py) regroup into one dict per
     # peer — {"seq": n, "ctr": {...}, "gauge": {...}, "hist": {...},
@@ -357,6 +389,7 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "stalls": stalls,
         "perf_events": perf_events,
         "learn_events": learn_events,
+        "remediation_events": remediation_events,
     }
 
 
@@ -458,10 +491,12 @@ def _fmt_slo(summary: dict[str, Any]) -> list[str]:
     gauges = summary.get("gauges", {})
     lat = hists.get("infer_latency_ms")
     # learn_* warn rows render (and flag) in the learning-health
-    # section instead — keep the SLO block serving-scoped
+    # section, remediation_* rows in the remediation section — keep
+    # the SLO block serving-scoped
     gauge_rows = [(name, gauges[name]) for name, row in INSTRUMENTS.items()
                   if row["kind"] == "gauge" and "warn" in row
-                  and name in gauges and not name.startswith("learn_")]
+                  and name in gauges
+                  and not name.startswith(("learn_", "remediation_"))]
     if not lat and not gauge_rows:
         return []
     lines = ["serving SLOs:"]
@@ -745,6 +780,47 @@ def _fmt_perf_events(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+def _fmt_remediation(summary: dict[str, Any]) -> list[str]:
+    """Remediation-plane section (runtime/remediation.py): the policy
+    engine's decisions grouped by rule/target/action/outcome, the
+    outcome counters, and the live action-budget headroom — flagged
+    when enforce mode has run out of tokens."""
+    events = summary.get("remediation_events", [])
+    gauges = summary.get("gauges", {})
+    ctrs = summary.get("ctrs", {})
+    mode_v = gauges.get("remediation_mode")
+    if not events and mode_v is None \
+            and "remediation_actions" not in ctrs:
+        return []
+    mode = {1.0: "observe", 2.0: "enforce"}.get(
+        float(mode_v) if mode_v is not None else 0.0, "off")
+    headroom = gauges.get("remediation_budget_headroom")
+    lines = [
+        f"remediation plane (mode={mode}):",
+        f"  applied={int(ctrs.get('remediation_actions', 0))} "
+        f"observed={int(ctrs.get('remediation_observed', 0))} "
+        f"suppressed={int(ctrs.get('remediation_suppressed', 0))} "
+        f"failed={int(ctrs.get('remediation_failed', 0))} "
+        f"budget_headroom={_n(headroom)} tokens"]
+    if events:
+        by_key: dict[tuple, int] = {}
+        for e in events:
+            key = (str(e.get("rule")), str(e.get("target")),
+                   str(e.get("action")), str(e.get("outcome")))
+            by_key[key] = by_key.get(key, 0) + 1
+        lines.append(f"  decisions ({len(events)}):")
+        for (rule, target, action, outcome), n in sorted(
+                by_key.items()):
+            lines.append(f"    {rule:<16} target={target:<12} "
+                         f"{action} -> {outcome} x{n}")
+    if mode == "enforce" and headroom is not None \
+            and float(headroom) < 1.0:
+        lines.append("    ⚠ action budget exhausted at last publish: "
+                     "enforce-mode decisions are being suppressed — "
+                     "faults outpace remediation.budget_per_min")
+    return lines
+
+
 def _fmt_peers(summary: dict[str, Any]) -> list[str]:
     """Per-peer fleet telemetry: one block per remote actor host with
     its heartbeat ages, ingest rate, stage-time breakdown, and any
@@ -858,6 +934,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if perf_lines:
         lines.append("")
         lines.extend(perf_lines)
+    remediation_lines = _fmt_remediation(summary)
+    if remediation_lines:
+        lines.append("")
+        lines.extend(remediation_lines)
     if summary["hbm"]:
         lines.append("")
         lines.append("compiled memory (XLA memory_analysis, bytes):")
@@ -889,6 +969,12 @@ def check_violations(summary: dict[str, Any]) -> list[str]:
         if row_kind == "gauge":
             raw = gauges.get(name)
             if raw is None:
+                continue
+            # budget exhaustion only gates enforce mode (mode gauge
+            # 2.0): an observe-mode engine that runs dry is telemetry,
+            # not an availability risk — no actuator was going to fire
+            if name == "remediation_budget_headroom" and float(
+                    gauges.get("remediation_mode", 0.0) or 0.0) < 2.0:
                 continue
             v = float(raw)
             if kind == "value_min":

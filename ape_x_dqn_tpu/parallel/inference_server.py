@@ -1047,6 +1047,27 @@ class MultiPolicyInferenceServer:
         return pending + self._q.qsize()
 
     @property
+    def backpressure_engaged(self) -> bool:
+        with self._lock:
+            return self._bp_engaged
+
+    def force_backpressure(self, engaged: bool) -> bool:
+        """Externally set the backpressure flag (the remediation
+        plane's queue-SLO actuator, runtime/remediation.py). Fires the
+        same gauge + transport callback as the admission controller's
+        own transitions; the controller keeps running, so if its
+        depth-based hysteresis disagrees it re-transitions on the next
+        shed/drain — the external setting is a nudge with a live
+        fallback, not an override that can wedge. Returns False on a
+        no-op (already in the requested state)."""
+        with self._lock:
+            if self._bp_engaged == bool(engaged):
+                return False
+            self._bp_engaged = bool(engaged)
+        self._fire_backpressure(bool(engaged))
+        return True
+
+    @property
     def stats(self) -> dict:
         with self._lock:
             return {"offered": self._offered,

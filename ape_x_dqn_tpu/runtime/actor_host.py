@@ -221,6 +221,37 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
                               daemon=True)
     puller.start()
 
+    # remediation plane, host side (runtime/remediation.py): the
+    # learner-side engine cannot reach this host's transport latch, so
+    # an ENFORCE-mode host runs a stale-latch watchdog of its own — a
+    # transport backpressure latch that the local admission controller
+    # DISAGREES with (tier released or never engaged, latch still set)
+    # for remediation.release_after_s is released locally. Complements
+    # the epoch-change clear in comm/socket_transport._note_epoch:
+    # that one needs a reply from the new incarnation to arrive; this
+    # one covers a latch desynced by a controller that went silent.
+    rcfg = getattr(cfg, "remediation", None)
+    if (rcfg is not None and rcfg.mode == "enforce"
+            and serving.multi_tenant and serving.backpressure):
+        def bp_watchdog() -> None:
+            stale_since: float | None = None
+            while not stop_event.wait(1.0):
+                stale = (raw_transport.backpressure_engaged
+                         and not tier.backpressure_engaged)
+                if not stale:
+                    stale_since = None
+                    continue
+                now = time.monotonic()
+                if stale_since is None:
+                    stale_since = now
+                elif now - stale_since >= rcfg.release_after_s:
+                    raw_transport.set_backpressure(False)
+                    obs.count("remediation_actions")
+                    stale_since = None
+
+        threading.Thread(target=bp_watchdog, name="remediation-bp",
+                         daemon=True).start()
+
     per_actor = frames_per_actor or (
         cfg.total_env_frames // max(cfg.actors.num_actors, 1))
     errors: list[tuple[int, Exception]] = []

@@ -52,6 +52,8 @@ from ape_x_dqn_tpu.runtime.evaluation import (
     EvalWorker, make_eval_policy_factory)
 from ape_x_dqn_tpu.runtime.ingest import IngestStager
 from ape_x_dqn_tpu.runtime.learner import DQNLearner
+from ape_x_dqn_tpu.runtime.remediation import (
+    Actuators, RemediationEngine)
 from ape_x_dqn_tpu.runtime.sequence_learner import SequenceLearner
 from ape_x_dqn_tpu.runtime.single_process import build_replay
 from ape_x_dqn_tpu.utils.checkpoint import CheckpointManager
@@ -263,6 +265,37 @@ class ApexDriver:
         self._slot_restarts: dict[int, int] = {}  # guarded-by: _lock
         self._quarantined: set[int] = set()  # guarded-by: _lock
         self._peer_quarantined: set[str] = set()  # guarded-by: _lock
+        # remediation-paused slots: slot -> remaining frame budget (the
+        # ingest-pressure autoscale rule parks the slot; resume respawns
+        # it with this budget). Distinct from _quarantined: paused is
+        # reversible and healthy, quarantined is exhausted.
+        self._slot_paused: dict[int, int] = {}  # guarded-by: _lock
+        # last transport+stage drop total the remediation sensor saw
+        # (supervisor tick thread only — no lock needed)
+        self._remed_dropped_seen = 0
+        # fleet remediation plane (runtime/remediation.py, ROADMAP item
+        # 4): the policy engine closing the monitor->actuator loop
+        # inside _supervise_tick. mode="off" (the default) never
+        # constructs it — the supervisor path stays bitwise the
+        # pre-remediation one. Actuators are this driver's own bounded
+        # methods; the monitors' fire listeners feed the event rules.
+        self.remediation: RemediationEngine | None = None
+        rcfg = getattr(cfg, "remediation", None)
+        if rcfg is not None and rcfg.mode != "off":
+            self.remediation = RemediationEngine(
+                rcfg, obs=self.obs, metrics=self.metrics,
+                actuators=Actuators(
+                    restart_actor=self._supervise_actor,
+                    quarantine_peer=self._quarantine_peer,
+                    pause_actor=self._pause_actor_slot,
+                    resume_actor=self._resume_actor_slot,
+                    set_backpressure=self._remediation_backpressure,
+                    set_priority=self._remediation_set_priority),
+                default_class=cfg.serving.default_class)
+            if getattr(self.obs, "perf", None) is not None:
+                self.obs.perf.add_listener(self.remediation.note_perf)
+            if getattr(self.obs, "learn", None) is not None:
+                self.obs.learn.add_listener(self.remediation.note_learn)
         self._ingested_batches = 0  # guarded-by: _lock
         # host-side mirror of replay fill so the learner hot loop never
         # blocks on a device->host read of state.replay.size (round-1
@@ -579,8 +612,21 @@ class ApexDriver:
           workers; this learner just stops waiting on it.
         - fatal locals (learner / ingest / inference-server / eval):
           fall through to check_stalled(), which raises the attributed
-          StallError — a driver cannot restart its own learner."""
+          StallError — a driver cannot restart its own learner.
+
+        With the remediation plane on (cfg.remediation.mode != "off"),
+        the engine ticks its gauge rules here and gets first claim on
+        stale actors/peers: in enforce mode a handled (applied) target
+        skips the default path — the engine's actuator IS the default
+        path's method, now cooldown-limited and attributed; any other
+        outcome (observed / cooldown / failed) falls through to the
+        pre-remediation behavior, so a wedged slot is never left for
+        check_stalled() to escalate into a run-fatal StallError."""
         obs = self.obs
+        eng = self.remediation
+        if eng is not None:
+            eng.tick(self._remediation_sensors(),
+                     step=self._grad_steps_total)
         if obs.watchdog is None:
             return
         if not getattr(self.cfg.actors, "supervise", False):
@@ -590,8 +636,15 @@ class ApexDriver:
                 obs.watchdog.timeout_s):
             slot = name[len("actor-"):] if name.startswith("actor-") else ""
             if slot.isdigit():
+                if eng is not None and eng.remediate_stale_actor(
+                        int(slot), staleness,
+                        step=self._grad_steps_total):
+                    continue
                 self._supervise_actor(int(slot), staleness)
             elif name not in self._FATAL_COMPONENTS:
+                if eng is not None and eng.remediate_stale_peer(
+                        name, staleness, step=self._grad_steps_total):
+                    continue
                 self._quarantine_peer(name, staleness)
         # anything still stale is a fatal local component
         obs.check_stalled()
@@ -684,6 +737,96 @@ class ApexDriver:
                 "quarantined from the stall watchdog (its host owns "
                 "recovery); ingest continues from the remaining fleet",
                 name, staleness)
+
+    # -- remediation actuators + sensors (runtime/remediation.py) ----------
+
+    def _pause_actor_slot(self, i: int) -> bool:
+        """Ingest-pressure autoscale actuator: park one RUNNING actor
+        slot by setting its generation stop event — the thread exits
+        cooperatively at its next stop check and clears its own
+        heartbeat (it stays the slot's current generation, so the
+        watchdog never sees a stale ghost). The remaining frame budget
+        is banked in _slot_paused for resume. Returns False when the
+        slot has nothing to pause (dead, quarantined, already paused)."""
+        with self._lock:
+            ev = self._slot_stops.get(i)
+            t = self._slot_threads.get(i)
+            if (ev is None or t is None or not t.is_alive()
+                    or i in self._quarantined or i in self._slot_paused):
+                return False
+            actor = self._slot_actor_obj.get(i)
+            budget = self._slot_budget.get(i, 0)
+            done = self._slot_done.get(i, 0)
+        if actor is not None:
+            try:
+                done += int(actor.frames)
+            except (TypeError, ValueError, AttributeError):
+                pass
+        remaining = max(budget - done, 0)
+        ev.set()
+        with self._lock:
+            self._slot_paused[i] = remaining
+        logging.getLogger(__name__).warning(
+            "[fleet] remediation paused actor slot %d under ingest "
+            "pressure (%d frames banked)", i, remaining)
+        return True
+
+    def _resume_actor_slot(self, i: int) -> bool:
+        """Resume a remediation-paused slot with its banked frame
+        budget (fresh generation, salted seed stream)."""
+        with self._lock:
+            remaining = self._slot_paused.pop(i, None)
+            restarts = self._slot_restarts.get(i, 0)
+            if remaining is None or i in self._quarantined:
+                return False
+        if remaining <= 0:
+            return False  # budget already produced; slot is finished
+        self._spawn_actor_slot(i, remaining, attempt0=200 + restarts)
+        return True
+
+    def _remediation_backpressure(self, engaged: bool) -> bool:
+        """Queue-SLO actuator: nudge the serving tier's backpressure
+        flag (same gauge + transport callback as the admission
+        controller's own transitions; the controller keeps running and
+        re-transitions if its depth-based hysteresis disagrees)."""
+        if self.serving is None:
+            return False
+        return self.serving.force_backpressure(engaged)  # apexlint: unaccounted(counted centrally in RemediationEngine._apply)
+
+    def _remediation_set_priority(self, tenant: str, cls: int) -> bool:
+        """Learn-health actuator: re-temper THIS driver's tenant
+        priority class. Only the tenant whose TenantClient this driver
+        owns is re-temperable — co-tenants' clients belong to their
+        registrants (their own drivers run their own engines)."""
+        if self.serving is None \
+                or getattr(self.server, "policy_id", None) != tenant:
+            return False
+        hi = self.cfg.serving.priority_classes - 1
+        self.server.priority = min(max(int(cls), 0), hi)
+        return True
+
+    def _remediation_sensors(self) -> dict:
+        """Fresh gauge-sensor snapshot for the engine's tick: serving
+        queue depth vs SLO, ingest drop pressure (delta since the last
+        tick), and the local slot population (supervisor-tick thread
+        only — the delta bookkeeping needs no lock)."""
+        s: dict[str, Any] = {}
+        if self.serving is not None:
+            s["queue_depth"] = self.serving.queue_depth
+            s["queue_slo"] = self.cfg.serving.queue_slo_items
+            s["backpressure"] = self.serving.backpressure_engaged
+        with self._lock:
+            running = [i for i, t in self._slot_threads.items()
+                       if t.is_alive() and i not in self._quarantined
+                       and i not in self._slot_paused]
+            paused = list(self._slot_paused)
+        dropped = (int(getattr(self.transport, "dropped", 0))
+                   + self._stage_dropped)
+        s["ingest_dropped_delta"] = dropped - self._remed_dropped_seen
+        self._remed_dropped_seen = dropped
+        s["running_slots"] = running
+        s["paused_slots"] = paused
+        return s
 
     def _min_fill(self) -> int:
         return min(self.cfg.replay.min_fill, self.capacity // 2)
@@ -1529,6 +1672,8 @@ class ApexDriver:
             "loop_errors": list(self.loop_errors),
             "eval": self.last_eval,
         }
+        if self.remediation is not None:
+            out["remediation"] = self.remediation.summary()
         if self._cold is not None:
             # transition-denominated door closure:
             # evicted == stored + dropped (tests/test_ingest.py)

@@ -959,24 +959,74 @@ def bench_wire_ab(args) -> dict:
     return out
 
 
+# chaos-lane availability recorded before the remediation plane (and
+# the wedged-actor fault) existed: the PERF.md "Chaos lane (round 10)"
+# number the remediation-on arm must hold even with the EXTRA fault in
+# its schedule. A hard floor, not a ratchet — it never moves down.
+_CHAOS_AVAIL_FLOOR = 0.822
+
+
+def _chaos_artifact_path(smoke: bool) -> str:
+    """Artifact of record for the chaos lane. Same smoke/full split as
+    the main bench: a CI smoke run only ever gates against a smoke
+    baseline."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "CHAOS_SMOKE.json" if smoke
+                        else "CHAOS_LATEST.json")
+
+
+def _load_chaos_baseline(smoke: bool, window_s: float, clients: int
+                         ) -> tuple[str | None, dict | None]:
+    """Newest COMPARABLE chaos artifact: same smoke class, same timed
+    window and sender-fleet size. Availability bakes in what fraction
+    of the window the fault schedule occupies — a cross-shape gate
+    would fire on a schedule change, not a regression."""
+    path = _chaos_artifact_path(smoke)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    if not (isinstance(doc, dict) and "metric" in doc
+            and "value" in doc):
+        return None, None
+    if (doc.get("window_s") != window_s
+            or doc.get("clients") != clients):
+        log(f"chaos gate: {os.path.basename(path)} is "
+            f"{doc.get('window_s')}s@{doc.get('clients')} clients, "
+            f"this run is {window_s}s@{clients} — not comparable, "
+            f"skipped")
+        return None, None
+    return path, doc
+
+
 def bench_chaos_ab(args) -> dict:
-    """A/B the elastic fleet runtime under fault injection: the same
+    """A/B/C the elastic fleet runtime under fault injection: the same
     sender fleet pushes experience through a chaos proxy for a fixed
-    wall-clock window, once over a clean link and once through the
-    full fault schedule — a garble phase, a link cut, and a learner
-    kill + restart (new incarnation, same port, new epoch). The
-    headline number is availability: chaos-arm ingest throughput as a
-    fraction of the clean arm's, with the outage that the reconnect
-    loop must amortize sitting INSIDE the timed window. Also reports
-    the client-measured reconnect latencies (time from first failed
-    send to the re-entered connection) and the fault attribution
-    counters the lane asserts on (every drop classified, every bad
-    frame counted)."""
+    wall-clock window — once over a clean link, and twice through the
+    full fault schedule (a garble phase, a link cut, a learner kill +
+    restart under a new epoch, and a WEDGED sender: silent but not
+    dead, the fault only a heartbeat/progress watchdog can see). The
+    chaos arm runs the drill with the remediation plane off, so the
+    wedged sender stays lost for the rest of the window; the
+    remediated arm runs the identical drill with a RemediationEngine
+    (runtime/remediation.py, enforce mode) watching per-sender send
+    progress and restarting the wedged slot. The headline number is
+    the remediated arm's availability: its ingest throughput as a
+    fraction of the clean arm's, with every outage INSIDE the timed
+    window — gated against the pre-remediation floor recorded in
+    PERF.md (the engine must at least buy back the extra fault it is
+    given). Also reports reconnect latencies and the fault attribution
+    counters the lane asserts on."""
     import threading
 
     from ape_x_dqn_tpu.comm.socket_transport import (
         SocketIngestServer, SocketTransport)
+    from ape_x_dqn_tpu.configs import RemediationConfig
+    from ape_x_dqn_tpu.runtime.remediation import (Actuators,
+                                                   RemediationEngine)
     from tools.chaos import ChaosProxy
+    from tools.chaos.faults import ThreadWedge
 
     n_wire, f, b = 8, 12, 12
     msgs = _wire_ab_messages(4, n_wire, f, b)
@@ -993,7 +1043,19 @@ def bench_chaos_ab(args) -> dict:
             time.sleep(0.1)
         return False
 
-    def arm(chaos: bool) -> dict:
+    class _ObsSink:
+        """Minimal obs facade for the engine (the bench has no Obs)."""
+
+        def __init__(self):
+            self.ctr: dict[str, int] = {}
+
+        def count(self, name, n=1):
+            self.ctr[name] = self.ctr.get(name, 0) + n
+
+        def gauge(self, name, value):
+            pass
+
+    def arm(chaos: bool, remediate: bool = False) -> dict:
         srv = SocketIngestServer("127.0.0.1", 0, epoch=1)
         port = srv.port
         proxy = ChaosProxy("127.0.0.1", port, seed=17)
@@ -1008,13 +1070,63 @@ def bench_chaos_ab(args) -> dict:
         rows = {"n": 0}
         decode_errs_prior = {"n": 0}  # from incarnations already stopped
         rows_lock = threading.Lock()
+        wedge = ThreadWedge()
+        # per-sender DELIVERY progress: the staleness signal the
+        # remediation supervisor reads (the miniature of the driver's
+        # heartbeat watchdog). Only a send that actually went out
+        # advances the slot — a wedged sender AND a sender stuck
+        # dropping into a backoff window both read as stale.
+        last_send = [time.monotonic()] * n_clients
 
         def pump(c, k):
             i = 0
             while not stop.is_set():
+                if k == 0:
+                    # the wedge's opt-in checkpoint: sender 0 freezes
+                    # here (silent, socket open) while engaged
+                    wedge.checkpoint(timeout=0.25)
+                    if wedge.engaged:
+                        continue
+                d0 = c.dropped
                 c.send_experience(msgs[(k + i) % len(msgs)])
+                if c.dropped == d0:  # delivered, not dropped
+                    last_send[k] = time.monotonic()
                 i += 1
                 time.sleep(0.002)
+
+        eng = None
+        obs_sink = _ObsSink()
+        if remediate:
+            def _restart(slot: int, staleness_s: float) -> bool:
+                # the driver's supervised slot respawn, approximated
+                # in place: a real restart builds a fresh actor thread
+                # (no wedge) and a fresh transport (no pending
+                # backoff). Releasing the wedge resumes the pump at
+                # its next checkpoint; kick() collapses a backoff
+                # window left over from the outage.
+                wedged = slot == 0 and wedge.engaged
+                if wedged:
+                    wedge.release()
+                kicked = clients[slot].kick()
+                return wedged or kicked
+
+            eng = RemediationEngine(
+                RemediationConfig(mode="enforce", hysteresis_ticks=1,
+                                  cooldown_s=0.25, budget_per_min=60.0),
+                obs_sink, None, Actuators(restart_actor=_restart))
+
+        def supervise():
+            # the driver's supervisor tick, miniaturized: per-sender
+            # progress staleness feeds the engine's safety rule
+            while not stop.is_set():
+                time.sleep(0.05)
+                now = time.monotonic()
+                for k in range(n_clients):
+                    staleness = now - last_send[k]
+                    # 0.2s of delivery silence is 100x the healthy
+                    # 2ms inter-send gap
+                    if staleness > 0.2:
+                        eng.remediate_stale_actor(k, staleness)
 
         def drain():
             while not stop.is_set():
@@ -1033,13 +1145,23 @@ def bench_chaos_ab(args) -> dict:
         threads = [threading.Thread(target=pump, args=(c, k),
                                     daemon=True)
                    for k, c in enumerate(clients)]
+        if eng is not None:
+            threads.append(threading.Thread(target=supervise,
+                                            daemon=True))
         drainer = threading.Thread(target=drain, daemon=True)
         t0 = time.monotonic()
         drainer.start()
         for t in threads:
             t.start()
         if chaos:
-            # fault schedule inside the window: degrade, cut, kill
+            # fault schedule inside the window: degrade, cut, kill —
+            # and a sender that wedges AT the kill, the worst case: a
+            # wedge inside the outage is indistinguishable from outage
+            # loss until the fleet recovers, and an unremediated one
+            # never comes back (it stays silent through the entire
+            # recovery tail). A remediated one is restarted off its
+            # progress staleness while everything is down anyway, so
+            # the wedge costs the remediated arm ~nothing.
             time.sleep(window_s * 0.25)
             proxy.set_fault(garble_rate=0.05)
             time.sleep(window_s * 0.25)
@@ -1047,14 +1169,16 @@ def bench_chaos_ab(args) -> dict:
             proxy.cut()
             decode_errs_prior["n"] = srv.wire_decode_errors
             srv.stop()
-            time.sleep(window_s * 0.15)  # the outage
+            wedge.engage()  # wedged-not-dead: silent, socket open
+            time.sleep(window_s * 0.10)  # the outage
             srv2 = SocketIngestServer("127.0.0.1", port, epoch=2)
             srv2.publish_params({"w": np.float32(1)}, 0)
             live["srv"] = srv2
-            time.sleep(window_s * 0.35)
+            time.sleep(window_s * 0.40)
         else:
             time.sleep(window_s)
         stop.set()
+        wedge.release()  # let a still-wedged pump observe stop
         for t in threads:
             t.join(timeout=2)
         drainer.join(timeout=5)
@@ -1077,6 +1201,10 @@ def bench_chaos_ab(args) -> dict:
             "wire_decode_errors": decode_errs_prior["n"]
             + live["srv"].wire_decode_errors,
         }
+        if eng is not None:
+            out["remediation"] = eng.summary()
+            out["remediation_actions"] = obs_sink.ctr.get(
+                "remediation_actions", 0)
         for c in clients:
             c.close()
         proxy.stop()
@@ -1085,23 +1213,32 @@ def bench_chaos_ab(args) -> dict:
 
     out: dict = {"window_s": window_s, "clients": n_clients,
                  "transitions_per_unit": b}
-    clean_runs, chaos_runs = [], []
+    clean_runs, chaos_runs, rem_runs = [], [], []
     for _ in range(args.repeats):
         clean = arm(chaos=False)
         chaos = arm(chaos=True)
+        rem = arm(chaos=True, remediate=True)
         clean_runs.append(clean["rows_per_s"])
         chaos_runs.append(chaos["rows_per_s"])
+        rem_runs.append(rem["rows_per_s"])
         out["clean"], out["chaos"] = clean, chaos
+        out["remediated"] = rem
     out["clean"]["rows_per_s"] = spread(clean_runs)
     out["chaos"]["rows_per_s"] = spread(chaos_runs)
+    out["remediated"]["rows_per_s"] = spread(rem_runs)
     out["availability"] = round(
         spread(chaos_runs)["median"] / spread(clean_runs)["median"], 3)
-    log(f"chaos A/B: clean {spread(clean_runs)} rows/s vs chaos "
-        f"{spread(chaos_runs)} rows/s -> availability "
-        f"{out['availability']} (reconnect median "
+    out["availability_remediated"] = round(
+        spread(rem_runs)["median"] / spread(clean_runs)["median"], 3)
+    log(f"chaos A/B/C: clean {spread(clean_runs)} rows/s, chaos "
+        f"{spread(chaos_runs)} rows/s (availability "
+        f"{out['availability']}), remediated {spread(rem_runs)} "
+        f"rows/s (availability {out['availability_remediated']}, "
+        f"{out['remediated'].get('remediation_actions', 0)} actions) — "
+        f"reconnect median "
         f"{out['chaos']['reconnect_latency_ms']['median']} ms, "
         f"decode errors {out['chaos']['wire_decode_errors']}, "
-        f"epochs converged {out['chaos']['epochs_converged']})")
+        f"epochs converged {out['chaos']['epochs_converged']}")
     return out
 
 
@@ -2723,14 +2860,46 @@ def main() -> None:
         return
     if args.chaos_ab:
         ab = bench_chaos_ab(args)
-        _emit({
-            "metric": "chaos_availability",
-            "value": ab["availability"],
-            "unit": "ratio",
+        result = {
+            "metric": "chaos_availability_remediated",
+            "value": ab["availability_remediated"],
+            # vs_baseline = the remediation-off arm under the SAME
+            # drill: the delta the engine is worth
             "vs_baseline": ab["availability"],
+            "unit": "ratio",
+            "window_s": ab["window_s"],
+            "clients": ab["clients"],
             "secondary": {"chaos_ab": ab},
-        }, args)
-        return
+        }
+        line = json.dumps(result)
+        gated = getattr(args, "perf_gate", False)
+        rc = 0
+        if gated:
+            args._baseline = _load_chaos_baseline(
+                args.smoke, ab["window_s"], ab["clients"])
+            rc = _gate_exit(result, args)
+        # the remediated arm must hold the pre-remediation
+        # availability floor on the full lane (smoke windows are too
+        # short for the ratio to be stable — the smoke lane gates via
+        # the anti-ratchet artifact alone)
+        if (not args.smoke
+                and ab["availability_remediated"] < _CHAOS_AVAIL_FLOOR):
+            log(f"chaos gate FAIL: remediated availability "
+                f"{ab['availability_remediated']} below the recorded "
+                f"pre-remediation floor {_CHAOS_AVAIL_FLOOR}")
+            rc = rc or 1
+        if rc == 0:
+            path = _chaos_artifact_path(args.smoke)
+            try:
+                with open(path, "w") as fh:
+                    fh.write(line + "\n")
+            except OSError as e:
+                log(f"could not write chaos artifact {path}: {e!r}")
+        else:
+            log("chaos perf-gate: artifact of record NOT updated by "
+                "this failing run")
+        print(line, flush=True)
+        raise SystemExit(rc)
     h2d_rates = bench_h2d(repeats=args.repeats)
     log(f"h2d link: {spread(h2d_rates)} MB/s (pure device_put, 64MB "
         f"buffer) — read ingest items/s against this")

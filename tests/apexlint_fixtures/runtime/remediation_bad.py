@@ -1,0 +1,18 @@
+"""Fixture: one actuator call with no counter bump and no waiver —
+exactly ONE remediation-accounting finding (the quarantine call; the
+counted restart above it must not mask the scope)."""
+
+
+class Engine:
+    def __init__(self, obs, actuators):
+        self._obs = obs
+        self._act = actuators
+
+    def apply_restart(self, slot):
+        out = self._act.restart_actor(slot, 0.0)
+        self._obs.count("remediation_actions")
+        return out
+
+    def apply_quarantine(self, peer):
+        # invisible action: no remediation_* counter in this scope
+        return self._act.quarantine_peer(peer, 0.0)

@@ -115,6 +115,21 @@ if ! python bench.py --serve-ab --smoke --perf-gate; then
     failed_files+=("bench.py --serve-ab --smoke")
 fi
 
+# Chaos-remediation smoke: the three-arm availability drill (clean /
+# chaos / chaos+remediation) from bench.py --chaos-ab. The remediated
+# arm must beat the last comparable (same window/clients)
+# CHAOS_SMOKE.json under --perf-gate — the anti-ratchet proves the
+# remediation plane keeps EARNING its availability win, not just that
+# it once did; failing runs never reseed the baseline. (The 0.822
+# PERF.md floor applies only to the full lane — the smoke window is
+# too short for an absolute bound.)
+echo
+echo "=== bench.py --chaos-ab --smoke"
+if ! python bench.py --chaos-ab --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --chaos-ab --smoke")
+fi
+
 echo
 if [ "${fail}" -ne 0 ]; then
     echo "FAILED files: ${failed_files[*]}"

@@ -25,8 +25,8 @@ sys.path.insert(0, REPO_ROOT)  # tools/ is repo-local, not installed
 
 from tools.apexlint import run as apexlint_run  # noqa: E402
 from tools.apexlint import config_coverage, guarded_by, host_sync, \
-    jit_purity, learner_parity, obs_names, retry_annotation, \
-    use_after_donate, wire_protocol  # noqa: E402
+    jit_purity, learner_parity, obs_names, remediation_accounting, \
+    retry_annotation, use_after_donate, wire_protocol  # noqa: E402
 
 
 def _fx(name: str) -> str:
@@ -55,7 +55,8 @@ def test_cli_json_subprocess():
     assert summary["findings"] == []
     assert set(summary["per_checker"]) == {
         "guarded-by", "jit-purity", "wire-protocol", "obs-names",
-        "retry-annotation", "use-after-donate", "host-sync",
+        "retry-annotation", "remediation-accounting",
+        "use-after-donate", "host-sync",
         "config-coverage", "learner-parity"}
     # per-checker shape feeds bench.py's secondary.apexlint lane
     for counts in summary["per_checker"].values():
@@ -314,6 +315,33 @@ def test_retry_annotation_scope_is_comm_and_runtime(tmp_path):
     elsewhere = tmp_path / "elsewhere.py"
     elsewhere.write_text(bad_src)
     res = retry_annotation.check_paths([str(elsewhere)])
+    assert res.findings == []
+
+
+def test_remediation_accounting_fixtures():
+    good = remediation_accounting.check_paths(
+        [_fx(os.path.join("runtime", "remediation_good.py"))])
+    assert good.findings == []
+    assert good.waivers == 1  # the justified central-dispatch waiver
+
+    bad = remediation_accounting.check_paths(
+        [_fx(os.path.join("runtime", "remediation_bad.py"))])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "remediation-accounting"
+    assert "quarantine_peer" in f.message and "unaccounted" in f.message
+
+
+def test_remediation_accounting_scope_is_runtime(tmp_path):
+    # an uncounted actuator call OUTSIDE runtime/ is not flagged: the
+    # rule enforces the remediation plane's audit-trail contract, not a
+    # repo-wide naming ban (bench.py wires bare actuators on purpose)
+    bad_src = open(
+        _fx(os.path.join("runtime", "remediation_bad.py")),
+        encoding="utf-8").read()
+    elsewhere = tmp_path / "elsewhere.py"
+    elsewhere.write_text(bad_src)
+    res = remediation_accounting.check_paths([str(elsewhere)])
     assert res.findings == []
 
 

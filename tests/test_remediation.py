@@ -1,0 +1,424 @@
+"""Fleet remediation plane (runtime/remediation.py, ISSUE 14).
+
+What the plane must prove, per bound:
+- hysteresis: a sensor flapping breach/clear every tick never moves an
+  actuator — streaks cannot accumulate through oscillation;
+- rate limits: per-target cooldown blocks re-application, the global
+  token bucket suppresses non-safety actions when exhausted, and
+  SAFETY actions (wedged-slot restart) bypass the bucket but not the
+  cooldown;
+- observe mode: the full decision pipeline runs and every decision is
+  attributed (JSONL + counters), but NO actuator is ever called;
+- the backpressure latch dies with the incarnation that set it: an
+  epoch change on the transport clears it (satellite of the same PR);
+- end to end: a real driver with the plane in enforce mode
+  auto-restarts a ThreadWedge'd actor slot from inside its supervisor
+  tick — no StallError, no driver exit — and the decision lands in the
+  run JSONL as an attributed `remediation` event.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from ape_x_dqn_tpu.comm.socket_transport import (
+    SocketIngestServer, SocketTransport)
+from ape_x_dqn_tpu.configs import RemediationConfig
+from ape_x_dqn_tpu.runtime.remediation import (
+    Actuators, RemediationEngine)
+from tools.chaos import ThreadWedge
+
+
+# -- fakes ------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class FakeObs:
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+
+    def count(self, name, n=1.0):
+        self.counters[name] = self.counters.get(name, 0.0) + n
+
+    def gauge(self, name, value):
+        self.gauges[name] = value
+
+
+class FakeMetrics:
+    def __init__(self):
+        self.records = []
+
+    def log(self, step, **kw):
+        self.records.append({"step": step, **kw})
+
+
+class CallLog:
+    """Actuators that record every invocation and report success."""
+
+    def __init__(self):
+        self.calls = []
+
+    def wire(self, **override):
+        def rec(name):
+            return lambda *a: (self.calls.append((name, a)), True)[1]
+        kw = {f: rec(f) for f in ("restart_actor", "quarantine_peer",
+                                  "pause_actor", "resume_actor",
+                                  "set_backpressure", "set_priority")}
+        kw.update(override)
+        return Actuators(**kw)
+
+    def named(self, name):
+        return [a for n, a in self.calls if n == name]
+
+
+def _engine(log, clock, metrics=None, obs=None, **cfg_kw):
+    cfg_kw.setdefault("mode", "enforce")
+    cfg_kw.setdefault("hysteresis_ticks", 2)
+    cfg_kw.setdefault("cooldown_s", 0.0)
+    cfg_kw.setdefault("budget_per_min", 60.0)
+    cfg = RemediationConfig(**cfg_kw)
+    return RemediationEngine(cfg, obs or FakeObs(),
+                             metrics or FakeMetrics(), log.wire(),
+                             default_class=1, clock=clock)
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"obs": rng.random((n, 4)).astype(np.float32),
+            "action": rng.integers(0, 2, (n,)).astype(np.int32),
+            "priorities": (rng.random(n) + 0.1).astype(np.float32),
+            "actor": 0, "frames": n}
+
+
+# -- hysteresis: flapping never trips an actuator ---------------------------
+
+def test_flapping_sensors_never_move_actuators():
+    clock, log = FakeClock(), CallLog()
+    metrics = FakeMetrics()
+    eng = _engine(log, clock, metrics=metrics, min_actors=1)
+    breach = {"queue_depth": 100.0, "queue_slo": 10.0,
+              "ingest_dropped_delta": 5.0, "running_slots": (0, 1)}
+    clear = {"queue_depth": 0.0, "queue_slo": 10.0,
+             "ingest_dropped_delta": 0.0, "running_slots": (0, 1)}
+    for i in range(20):  # breach/clear oscillation, 10 full cycles
+        eng.tick(breach if i % 2 == 0 else clear)
+        clock.advance(1.0)
+    assert log.calls == []  # no actuator ever moved
+    # no decision was even emitted: flapping is not a policy event
+    assert metrics.records == []
+    assert "applied" not in eng.summary()["counts"]
+
+
+def test_sustained_breach_engages_then_sustained_clear_releases():
+    clock, log = FakeClock(), CallLog()
+    eng = _engine(log, clock)
+    breach = {"queue_depth": 100.0, "queue_slo": 10.0}
+    clear = {"queue_depth": 0.0, "queue_slo": 10.0}
+    for _ in range(2):  # hysteresis_ticks consecutive agreeing ticks
+        eng.tick(breach)
+        clock.advance(1.0)
+    assert log.named("set_backpressure") == [(True,)]
+    for _ in range(3):  # staying breached does not re-apply
+        eng.tick(breach)
+        clock.advance(1.0)
+    assert log.named("set_backpressure") == [(True,)]
+    for _ in range(2):
+        eng.tick(clear)
+        clock.advance(1.0)
+    assert log.named("set_backpressure") == [(True,), (False,)]
+
+
+# -- rate limits: cooldown, budget, safety bypass ---------------------------
+
+def test_per_target_cooldown_blocks_reapplication():
+    clock, log = FakeClock(), CallLog()
+    eng = _engine(log, clock, cooldown_s=10.0)
+    assert eng.remediate_stale_actor(0, 5.0) is True
+    # inside the window the same remedy on the same target is refused —
+    # the driver falls back to its own (escalating) supervisor path
+    clock.advance(1.0)
+    assert eng.remediate_stale_actor(0, 5.0) is False
+    assert len(log.named("restart_actor")) == 1
+    assert eng.summary()["counts"]["cooldown"] >= 1
+    # a DIFFERENT target is not in this target's cooldown
+    assert eng.remediate_stale_actor(1, 5.0) is True
+    clock.advance(10.0)  # window over: the remedy is available again
+    assert eng.remediate_stale_actor(0, 5.0) is True
+    assert len(log.named("restart_actor")) == 3
+
+
+def test_budget_exhaustion_suppresses_nonsafety_but_not_safety():
+    clock, log = FakeClock(), CallLog()
+    obs = FakeObs()
+    eng = _engine(log, clock, obs=obs, hysteresis_ticks=1,
+                  budget_per_min=1.0, min_actors=1)
+    # the single token goes to the backpressure engage
+    eng.tick({"queue_depth": 100.0, "queue_slo": 10.0})
+    assert log.named("set_backpressure") == [(True,)]
+    # bucket empty (clock frozen, no refill): the next non-safety
+    # action is suppressed, attributed, and the actuator never runs
+    eng.tick({"ingest_dropped_delta": 5.0, "running_slots": (0, 1)})
+    assert log.named("pause_actor") == []
+    assert eng.summary()["counts"]["suppressed"] >= 1
+    assert obs.counters.get("remediation_suppressed", 0) >= 1
+    # SAFETY bypasses the bucket: a wedged slot restarts on zero tokens
+    assert eng.remediate_stale_actor(0, 9.0) is True
+    assert len(log.named("restart_actor")) == 1
+    # a minute later the bucket refilled and the paused rule can act
+    clock.advance(60.0)
+    eng.tick({"ingest_dropped_delta": 5.0, "running_slots": (0, 1)})
+    assert log.named("pause_actor") == [(1,)]
+    # headroom gauge published for the report's INSTRUMENTS row
+    assert "remediation_budget_headroom" in obs.gauges
+
+
+# -- observe mode: attributed dry run, actuators untouched ------------------
+
+def test_observe_mode_emits_but_never_acts():
+    clock, log = FakeClock(), CallLog()
+    obs, metrics = FakeObs(), FakeMetrics()
+    eng = _engine(log, clock, mode="observe", obs=obs, metrics=metrics,
+                  hysteresis_ticks=1)
+    # safety rule: decision observed, NOT handled (driver falls back)
+    assert eng.remediate_stale_actor(0, 5.0) is False
+    # gauge rule: full state machine runs dry (engage then release)
+    eng.tick({"queue_depth": 100.0, "queue_slo": 10.0})
+    clock.advance(1.0)
+    eng.tick({"queue_depth": 0.0, "queue_slo": 10.0})
+    assert log.calls == []  # no actuator was EVER called
+    outcomes = {r["remediation_outcome"] for r in metrics.records}
+    assert outcomes == {"observed"}
+    labels = {r["remediation_action"] for r in metrics.records}
+    assert {"restart_actor", "engage_backpressure",
+            "release_backpressure"} <= labels
+    assert obs.counters["remediation_observed"] == len(metrics.records)
+    assert obs.gauges.get("remediation_mode") == 1.0
+    # every record is fully attributed for the report's decision table
+    for rec in metrics.records:
+        assert rec["remediation"] and rec["remediation_target"]
+
+
+def test_unwired_actuator_degrades_per_rule_not_crash():
+    clock, log = FakeClock(), CallLog()
+    eng = RemediationEngine(
+        RemediationConfig(mode="enforce", hysteresis_ticks=1,
+                          cooldown_s=0.0),
+        FakeObs(), FakeMetrics(),
+        log.wire(restart_actor=None), clock=clock)
+    # missing callable: outcome "unwired", never an exception, and NOT
+    # handled — the driver's default supervisor path takes over
+    assert eng.remediate_stale_actor(0, 5.0) is False
+    assert eng.summary()["counts"]["unwired"] == 1
+
+
+def test_failing_actuator_is_contained_and_counted():
+    clock, log = FakeClock(), CallLog()
+    obs = FakeObs()
+
+    def boom(*a):
+        raise RuntimeError("actuator exploded")
+
+    eng = RemediationEngine(
+        RemediationConfig(mode="enforce", hysteresis_ticks=1,
+                          cooldown_s=0.0),
+        obs, FakeMetrics(), log.wire(restart_actor=boom), clock=clock)
+    assert eng.remediate_stale_actor(0, 5.0) is False  # fell back
+    assert eng.summary()["counts"]["failed"] == 1
+    assert obs.counters["remediation_failed"] == 1
+
+
+# -- the latch dies with its incarnation (satellite: transport) -------------
+
+def test_backpressure_does_not_survive_learner_incarnation_change():
+    """REGRESSION: the serving tier's backpressure latch is engaged by
+    ONE learner incarnation's admission controller. Left set across an
+    epoch change it would shed every send into the NEW incarnation
+    forever (the controller that would release it is dead). The
+    transport must clear it the moment it observes the new epoch."""
+    srv1 = SocketIngestServer("127.0.0.1", 0, epoch=1)
+    port = srv1.port
+    client = SocketTransport("127.0.0.1", port, reconnect_base_s=0.01,
+                             reconnect_cap_s=0.2)
+    srv2 = None
+    try:
+        client.send_experience(_batch())
+        assert srv1.recv_experience(timeout=5.0) is not None
+        assert client.epoch == 1
+
+        client.set_backpressure(True)
+        assert client.backpressure_engaged
+        client.send_experience(_batch())  # latched: host-side drop
+        assert client.drop_reasons["backpressure"] >= 1
+
+        srv1.stop()  # the incarnation that engaged the latch dies
+        srv2 = SocketIngestServer("127.0.0.1", port, epoch=2)
+        srv2.publish_params({"w": 1}, 0)
+        # the experience path is latched shut, so the param plane is
+        # where the new epoch is first observed — exactly the deadlock
+        # the clear exists to break
+        assert _wait(lambda: (client.get_params(),
+                              client.epoch_changes >= 1)[1]), \
+            "client never observed the new incarnation"
+        assert not client.backpressure_engaged
+
+        def resumed():
+            client.send_experience(_batch())
+            return srv2.recv_experience(timeout=0.2) is not None
+
+        assert _wait(resumed), "ingest never resumed post-clear"
+    finally:
+        client.close()
+        if srv2 is not None:
+            srv2.stop()
+
+
+def test_kick_collapses_pending_backoff_only():
+    """The remediation plane's in-place restart equivalent: kick()
+    zeroes a PENDING reconnect backoff so the next send retries now,
+    and reports not-applicable (False -> outcome "skipped") when there
+    is nothing to collapse."""
+    srv = SocketIngestServer("127.0.0.1", 0, epoch=1)
+    port = srv.port
+    client = SocketTransport("127.0.0.1", port, reconnect_base_s=30.0,
+                             reconnect_cap_s=60.0)
+    try:
+        client.send_experience(_batch())
+        assert srv.recv_experience(timeout=5.0) is not None
+        assert client.kick() is False  # healthy: nothing pending
+        srv.stop()
+
+        def hard_drop():
+            client.send_experience(_batch())
+            r = client.drop_reasons
+            return (r["reset"] + r["refused"] + r["timeout"]
+                    + r["other"] >= 1)
+
+        assert _wait(hard_drop, timeout=3.0)
+        # backoff armed for ~30s: sends now drop without touching the
+        # network; kick() collapses the window
+        client.send_experience(_batch())
+        assert client.drop_reasons["backpressure"] >= 1
+        assert client.kick() is True
+        assert client.kick() is False  # idempotent: already collapsed
+        srv2 = SocketIngestServer("127.0.0.1", port, epoch=2)
+        try:
+            def resumed():
+                client.send_experience(_batch())
+                return srv2.recv_experience(timeout=0.2) is not None
+
+            assert _wait(resumed), "kicked sender never resumed"
+        finally:
+            srv2.stop()
+    finally:
+        client.close()
+
+
+# -- chaos e2e: a wedged actor is auto-restarted, the driver survives -------
+
+def test_enforce_mode_auto_restarts_wedged_actor(tmp_path):
+    """The tentpole loop, closed end to end on a REAL driver: an actor
+    slot wedges (cooperative ThreadWedge, the wedged-not-dead fault
+    shape), its heartbeat goes stale past the watchdog timeout, and the
+    supervisor tick's remediation engine restarts the slot — the
+    driver does not raise, does not exit, and the decision is an
+    attributed `remediation` event in the run JSONL."""
+    from ape_x_dqn_tpu.configs import (
+        ActorConfig, InferenceConfig, LearnerConfig, ObsConfig,
+        ReplayConfig, get_config)
+    from ape_x_dqn_tpu.runtime.driver import ApexDriver
+    from ape_x_dqn_tpu.utils.metrics import Metrics
+
+    jsonl = str(tmp_path / "run.jsonl")
+    cfg = get_config("cartpole_smoke").replace(
+        actors=ActorConfig(num_actors=2, ingest_batch=16,
+                           supervise=True, supervisor_max_restarts=2),
+        replay=ReplayConfig(kind="prioritized", capacity=1024,
+                            min_fill=64),
+        learner=LearnerConfig(batch_size=32, n_step=3,
+                              target_sync_every=100, publish_every=20),
+        inference=InferenceConfig(max_batch=8, deadline_ms=1.0),
+        obs=ObsConfig(enabled=True, heartbeat_timeout_s=0.3),
+        remediation=RemediationConfig(mode="enforce",
+                                      hysteresis_ticks=1,
+                                      cooldown_s=0.05,
+                                      budget_per_min=60.0),
+        eval_every_steps=0, eval_episodes=0)
+    driver = ApexDriver(cfg, metrics=Metrics(log_path=jsonl))
+    assert driver.remediation is not None
+
+    spawned = []
+    real_spawn = driver._spawn_actor_slot
+    driver._spawn_actor_slot = \
+        lambda i, f, attempt0=0: spawned.append((i, f, attempt0))
+
+    wedge = ThreadWedge()
+    stop = threading.Event()
+    driver.obs.register("actor-0")
+
+    def actor_loop():  # the slot's heartbeat source, wedgeable
+        while not stop.is_set():
+            wedge.checkpoint(timeout=5.0)
+            if stop.is_set():
+                return
+            driver.obs.beat("actor-0", "looping")
+            time.sleep(0.02)
+
+    t = threading.Thread(target=actor_loop, daemon=True)
+    t.start()
+    try:
+        assert _wait(
+            lambda: driver.obs.heartbeats.ages().get(
+                "actor-0", (99.0, ""))[0] < 0.1)
+        driver._slot_budget[0] = 640
+        wedge.engage()  # the fault: alive thread, silent heartbeat
+        time.sleep(driver.obs.watchdog.timeout_s + 0.15)
+
+        driver._supervise_tick()  # must restart, NOT raise StallError
+
+        assert spawned and spawned[0][0] == 0
+        assert driver._slot_restarts[0] == 1
+        summary = driver.remediation.summary()
+        assert summary["counts"].get("applied", 0) >= 1
+        assert summary["decided_by_rule"].get("actor-wedge") == 1
+        assert driver.obs.registry.counter(
+            "remediation_actions").value >= 1
+        # the re-armed heartbeat keeps the next immediate tick green
+        driver._supervise_tick()
+        assert len(spawned) == 1
+
+        events = [json.loads(line)
+                  for line in open(jsonl, encoding="utf-8")
+                  if "remediation" in line]
+        hits = [e for e in events
+                if e.get("remediation") == "actor-wedge"
+                and e.get("remediation_outcome") == "applied"]
+        assert hits and hits[0]["remediation_target"] == "actor-0"
+        assert hits[0]["remediation_action"] == "restart_actor"
+    finally:
+        driver._spawn_actor_slot = real_spawn
+        stop.set()
+        wedge.release()
+        t.join(timeout=2)
+        driver.obs.clear("actor-0")
+        driver.obs.close()

@@ -1,6 +1,6 @@
 """apexlint: project-native static analysis for the Ape-X runtime.
 
-Nine stdlib-only AST checkers over the package source (no imports of
+Ten stdlib-only AST checkers over the package source (no imports of
 the code under analysis, no third-party deps). The v1 five are
 single-file passes; v2 added a shared cross-module call graph
 (callgraph.py) and four whole-program dataflow checkers:
@@ -13,6 +13,10 @@ single-file passes; v2 added a shared cross-module call graph
 - obs-names        emitted instruments <-> obs/report.py table
 - retry-annotation swallowed socket errors in comm/runtime must emit
                    an accounting bump or carry `# apexlint: lossy(...)`
+- remediation-accounting
+                   every fleet-actuator call site in runtime/ bumps a
+                   remediation_* counter or carries
+                   `# apexlint: unaccounted(...)`
 - use-after-donate no reads of a buffer after it was donated to a
                    `donate_argnums` jit without an intervening rebind
 - host-sync        no hidden `.item()`/`np.asarray`/`float()`/
@@ -39,7 +43,8 @@ import os
 
 from tools.apexlint import (
     config_coverage, guarded_by, host_sync, jit_purity, learner_parity,
-    obs_names, retry_annotation, use_after_donate, wire_protocol)
+    obs_names, remediation_accounting, retry_annotation,
+    use_after_donate, wire_protocol)
 from tools.apexlint.common import CheckResult, Finding, ModuleSource
 
 __all__ = ["CheckResult", "Finding", "ModuleSource", "run",
@@ -80,6 +85,8 @@ def run(package_dir: str,
     fold("jit-purity", jit_purity.check_paths(paths))
     fold("wire-protocol", wire_protocol.check_paths(paths))
     fold("retry-annotation", retry_annotation.check_paths(paths))
+    fold("remediation-accounting",
+         remediation_accounting.check_paths(paths))
     fold("use-after-donate", use_after_donate.check_paths(paths))
     fold("host-sync", host_sync.check_paths(paths))
     fold("learner-parity", learner_parity.check_paths(paths))

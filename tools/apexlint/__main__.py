@@ -75,8 +75,8 @@ def main(argv: list[str] | None = None) -> int:
         prog="python -m tools.apexlint",
         description="Ape-X project lint: guarded-by, jit-purity, "
                     "wire-protocol, obs-names, retry-annotation, "
-                    "use-after-donate, host-sync, config-coverage, "
-                    "learner-parity.")
+                    "remediation-accounting, use-after-donate, "
+                    "host-sync, config-coverage, learner-parity.")
     ap.add_argument("package", nargs="?", default=None,
                     help="package directory to scan (e.g. "
                          "ape_x_dqn_tpu/)")

@@ -39,9 +39,11 @@ CHECKER = "config-coverage"
 
 PREFIX_TO_CLASS = {"replay": "ReplayConfig", "comm": "CommConfig",
                    "obs": "ObsConfig", "actors": "ActorConfig",
-                   "serving": "ServingConfig"}
+                   "serving": "ServingConfig",
+                   "remediation": "RemediationConfig"}
 KNOB_RE = re.compile(
-    r"\b(replay|comm|obs|actors|serving)\.([a-z_][a-z0-9_]*)")
+    r"\b(replay|comm|obs|actors|serving|remediation)"
+    r"\.([a-z_][a-z0-9_]*)")
 
 
 def _is_dataclass(cls: ast.ClassDef) -> bool:
@@ -127,6 +129,8 @@ def check(paths: list[str], configs_path: str | None = None,
             for lineno, text in enumerate(fh, start=1):
                 for m in KNOB_RE.finditer(text):
                     prefix, attr = m.group(1), m.group(2)
+                    if attr == "py":
+                        continue  # `remediation.py` is a filename
                     cls_name = PREFIX_TO_CLASS[prefix]
                     fields = classes.get(cls_name)
                     if fields is None or attr in fields:

@@ -5,6 +5,14 @@ Point actor hosts at the proxy's listen port instead of the learner
 and watch the run's obs artifacts attribute every injected fault
 (wire_decode_errors, peer_disconnects, reconnect latencies). SIGINT
 prints the fault stats and exits.
+
+Reproducible drills: the startup line prints the RNG seed, and
+`--scenario <name>` runs a named preset built from the set_fault/cut
+primitives — each phase transition is printed, so any drill can be
+re-run exactly from a log or bench artifact (same seed, same
+scenario, same phase schedule). A scenario takes over fault control:
+its clean phases reset ALL rates, including ones given on the
+command line.
 """
 
 from __future__ import annotations
@@ -14,6 +22,22 @@ import sys
 import time
 
 from tools.chaos.proxy import ChaosProxy
+
+# name -> cyclic phase list of (duration_s, action); action is "cut"
+# (sever all live sockets once), "clean" (all fault rates to 0), or a
+# set_fault(**kwargs) dict. Durations are fixed so a logged drill
+# replays exactly.
+SCENARIOS = {
+    # periodic learner blip: sever everything, give the fleet a clean
+    # recovery window, repeat — the supervised-reconnect drill
+    "kill-recover": [(0.0, "cut"), (20.0, "clean")],
+    # bursts of payload corruption against a clean baseline — the
+    # wire-decode-error accounting drill
+    "garble-storm": [(5.0, {"garble_rate": 0.05}), (10.0, "clean")],
+    # fast alternation of heavy drop and clean — the flapping-sensor
+    # drill the remediation plane's hysteresis must not oscillate on
+    "flap": [(2.0, {"drop_rate": 0.5}), (2.0, "clean")],
+}
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -30,28 +54,63 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--cut-every", type=float, default=0.0,
                     help="seconds between cutting all live connections "
                          "(0 = never): the periodic learner-blip drill")
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    default=None,
+                    help="named fault-schedule preset; phase "
+                         "transitions are printed so the drill can be "
+                         "re-run exactly from any log")
     args = ap.parse_args(argv)
     host, port = args.connect.rsplit(":", 1)
     proxy = ChaosProxy(host, int(port), listen_port=args.listen,
                        drop_rate=args.drop, delay_s=args.delay,
                        truncate_rate=args.truncate,
                        garble_rate=args.garble, seed=args.seed)
-    print(f"chaos proxy: :{proxy.port} -> {host}:{port}", flush=True)
+    scen = f" scenario={args.scenario}" if args.scenario else ""
+    print(f"chaos proxy: :{proxy.port} -> {host}:{port} "
+          f"seed={args.seed}{scen}", flush=True)
     try:
-        last_cut = time.monotonic()
-        while True:
-            time.sleep(0.5)
-            if args.cut_every > 0 \
-                    and time.monotonic() - last_cut >= args.cut_every:
-                n = proxy.cut()
-                last_cut = time.monotonic()
-                print(f"chaos proxy: cut {n} sockets", flush=True)
+        if args.scenario:
+            _run_scenario(proxy, args.scenario)
+        else:
+            _run_static(proxy, args.cut_every)
     except KeyboardInterrupt:
         pass
     finally:
         proxy.stop()
         print(f"chaos proxy stats: {proxy.stats}", file=sys.stderr)
     return 0
+
+
+def _run_static(proxy: ChaosProxy, cut_every: float) -> None:
+    last_cut = time.monotonic()
+    while True:
+        time.sleep(0.5)
+        if cut_every > 0 \
+                and time.monotonic() - last_cut >= cut_every:
+            n = proxy.cut()
+            last_cut = time.monotonic()
+            print(f"chaos proxy: cut {n} sockets", flush=True)
+
+
+def _run_scenario(proxy: ChaosProxy, name: str) -> None:
+    phases = SCENARIOS[name]
+    i = 0
+    while True:
+        duration, action = phases[i % len(phases)]
+        if action == "cut":
+            n = proxy.cut()
+            print(f"chaos scenario {name}: cut {n} sockets",
+                  flush=True)
+        elif action == "clean":
+            proxy.clean()
+            print(f"chaos scenario {name}: clean", flush=True)
+        else:
+            proxy.set_fault(**action)
+            print(f"chaos scenario {name}: set_fault {action}",
+                  flush=True)
+        if duration > 0:
+            time.sleep(duration)
+        i += 1
 
 
 if __name__ == "__main__":
