@@ -114,6 +114,32 @@ class ReplayConfig:
     cold_tier_refill: int = 1
     # zlib level for cold segments (1 = speed, the wire codec's choice)
     cold_tier_compress_level: int = 1
+    # -- disk-spill rung below the cold store (replay/disk_store.py),
+    # default OFF ------------------------------------------------------
+    # cold_tier_disk_capacity > 0 (transitions; requires the RAM tier)
+    # adds an append-only segment-file rung under the cold store: RAM
+    # door losers (displaced victims + live door-dropped candidates)
+    # spill to disk via an async writeback thread instead of vanishing,
+    # and the idle refill tick promotes the heaviest disk segments back
+    # through the RAM door. Retention becomes a disk-provisioning knob
+    # (10^8+ transitions at the cold tier's ~10x compression). 0 keeps
+    # the RAM-only tier bitwise untouched.
+    cold_tier_disk_capacity: int = 0
+    # segment-file directory; REQUIRED non-empty when the disk rung is
+    # on (an existing directory is recovered: index rebuilt from record
+    # headers, torn tails truncated)
+    cold_tier_disk_dir: str = ""
+    # bounded writeback queue depth (segments). The ship path NEVER
+    # waits on disk: a full queue counts cold_disk_queue_full and drops.
+    cold_tier_disk_queue: int = 16
+    # roll segment files at this size; compaction granularity
+    cold_tier_disk_file_bytes: int = 64 * 1024 * 1024
+    # compact a sealed file when its dead-byte fraction exceeds this
+    cold_tier_disk_compact_frac: float = 0.5
+    # disk segments promoted back toward the RAM store per idle refill
+    # tick (after RAM recalls); 0 disables promotion while still
+    # capturing spills
+    cold_tier_disk_promote: int = 1
 
     def __post_init__(self) -> None:
         if self.cold_tier_capacity < 0:
@@ -137,6 +163,42 @@ class ReplayConfig:
                     f".so is required, the numpy fallback is "
                     f"bit-identical) or set replay.cold_tier_capacity=0 "
                     f"to run single-tier.")
+        if self.cold_tier_disk_capacity < 0:
+            raise ValueError(
+                f"replay.cold_tier_disk_capacity must be >= 0 (got "
+                f"{self.cold_tier_disk_capacity}); 0 disables the disk "
+                f"rung")
+        if self.cold_tier_disk_capacity > 0:
+            if self.cold_tier_capacity <= 0:
+                raise ValueError(
+                    "replay.cold_tier_disk_capacity > 0 requires the "
+                    "RAM cold tier (replay.cold_tier_capacity > 0): "
+                    "the disk rung only sees segments through the RAM "
+                    "store's admission door")
+            if not self.cold_tier_disk_dir:
+                raise ValueError(
+                    "replay.cold_tier_disk_capacity > 0 requires "
+                    "replay.cold_tier_disk_dir (the segment-file "
+                    "directory; created if missing, recovered if it "
+                    "holds prior segment files)")
+            if self.cold_tier_disk_queue < 1:
+                raise ValueError(
+                    f"replay.cold_tier_disk_queue must be >= 1 (got "
+                    f"{self.cold_tier_disk_queue}): the writeback "
+                    f"queue needs at least one slot")
+            if self.cold_tier_disk_file_bytes < 1024:
+                raise ValueError(
+                    f"replay.cold_tier_disk_file_bytes must be >= 1024 "
+                    f"(got {self.cold_tier_disk_file_bytes}); one file "
+                    f"must hold at least one record")
+            if not (0.0 < self.cold_tier_disk_compact_frac <= 1.0):
+                raise ValueError(
+                    f"replay.cold_tier_disk_compact_frac must be in "
+                    f"(0, 1] (got {self.cold_tier_disk_compact_frac})")
+            if self.cold_tier_disk_promote < 0:
+                raise ValueError(
+                    f"replay.cold_tier_disk_promote must be >= 0 (got "
+                    f"{self.cold_tier_disk_promote})")
 
 
 @dataclass(frozen=True)

@@ -192,6 +192,26 @@ INSTRUMENTS = {
                  "data and its guard is broken")},
     "cold_evictions": {"kind": "ctr"},
     "cold_recalls": {"kind": "ctr"},
+    # cold-door outcomes (ISSUE 16): every ring eviction either stores,
+    # displaces a lighter resident segment, or drops at the door. Drops
+    # persistently outrunning displacements means the door is rejecting
+    # mass the store has no room to absorb — the thrashing signal the
+    # disk rung exists to absorb (check_violations has a bespoke row).
+    "cold_dropped": {"kind": "ctr"},
+    "cold_displaced": {"kind": "ctr"},
+    # disk spill rung (replay/disk_store.py, ISSUE 16): append-only
+    # segment files below the host-RAM cold store. Spills ride an async
+    # writeback queue off the ingest thread (queue_full counts offers
+    # the full queue refused — never waited on); promotions re-enter
+    # the RAM store during the idle refill tick. cold_disk_errors is
+    # lost-segment IO failures (writeback append / promote read).
+    "cold_disk_spills": {"kind": "ctr"},
+    "cold_disk_promotions": {"kind": "ctr"},
+    "cold_disk_queue_full": {"kind": "ctr"},
+    "cold_disk_errors": {"kind": "ctr"},
+    "cold_disk_segments": {"kind": "gauge"},
+    "cold_disk_transitions": {"kind": "gauge"},
+    "cold_disk_bytes": {"kind": "gauge"},
     # multi-tenant serving tier (parallel/inference_server.py, ISSUE
     # 13): admission-controller accounting closes by construction —
     # serve_offered == serve_admitted + serve_shed at quiescence (shed
@@ -780,6 +800,50 @@ def _fmt_perf_events(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+def _fmt_cold(summary: dict[str, Any]) -> list[str]:
+    """Tiered-replay section (replay/cold_store.py +
+    replay/disk_store.py): the host-RAM cold store's residency and
+    door outcomes, and — when the disk rung is enabled — the spill /
+    promotion / queue-refusal counters of the async writeback tier.
+    Mirrors the bespoke cold-door thrash row in check_violations."""
+    gauges = summary.get("gauges", {})
+    ctrs = summary.get("ctrs", {})
+    if "cold_segments" not in gauges \
+            and "cold_evictions" not in ctrs:
+        return []
+    lines = [
+        "tiered replay (host-RAM cold store):",
+        f"  resident: segments={_n(gauges.get('cold_segments'))} "
+        f"bytes={_n(gauges.get('cold_bytes'))} "
+        f"compression={_n(gauges.get('cold_compression_ratio'))}x",
+        f"  door: evictions={int(ctrs.get('cold_evictions', 0))} "
+        f"recalls={int(ctrs.get('cold_recalls', 0))} "
+        f"displaced={int(ctrs.get('cold_displaced', 0))} "
+        f"dropped={int(ctrs.get('cold_dropped', 0))}"]
+    drops = int(ctrs.get("cold_dropped", 0))
+    displ = int(ctrs.get("cold_displaced", 0))
+    disk_on = "cold_disk_transitions" in gauges \
+        or "cold_disk_spills" in ctrs
+    if disk_on:
+        lines.append(
+            f"  disk rung: segments="
+            f"{_n(gauges.get('cold_disk_segments'))} "
+            f"transitions={_n(gauges.get('cold_disk_transitions'))} "
+            f"bytes={_n(gauges.get('cold_disk_bytes'))}")
+        lines.append(
+            f"    spills={int(ctrs.get('cold_disk_spills', 0))} "
+            f"promotions={int(ctrs.get('cold_disk_promotions', 0))} "
+            f"queue_full={int(ctrs.get('cold_disk_queue_full', 0))} "
+            f"io_errors={int(ctrs.get('cold_disk_errors', 0))}")
+    if drops > displ and int(ctrs.get("cold_disk_spills", 0)) < drops:
+        lines.append("    ⚠ door drops outrun displacements and disk "
+                     "spills did not absorb them — the store is "
+                     "saturated with heavier segments and experience "
+                     "is lost at the door (grow cold_tier_capacity or "
+                     "enable cold_tier_disk_capacity)")
+    return lines
+
+
 def _fmt_remediation(summary: dict[str, Any]) -> list[str]:
     """Remediation-plane section (runtime/remediation.py): the policy
     engine's decisions grouped by rule/target/action/outcome, the
@@ -926,6 +990,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if ingest_lines:
         lines.append("")
         lines.extend(ingest_lines)
+    cold_lines = _fmt_cold(summary)
+    if cold_lines:
+        lines.append("")
+        lines.extend(cold_lines)
     peer_lines = _fmt_peers(summary)
     if peer_lines:
         lines.append("")
@@ -1005,6 +1073,24 @@ def check_violations(summary: dict[str, Any]) -> list[str]:
         if p99 is not None and float(p99) > lat_bound:
             out.append(f"serve/{tenant}/p99_ms: value={_n(float(p99))} "
                        f"> healthy {_n(float(lat_bound))} — {lat_why}")
+    # cold-door thrash (ISSUE 16): door drops outrunning displacements
+    # means evicted mass is being rejected outright rather than
+    # displacing lighter residents — the store is saturated with
+    # heavier segments and experience is being lost at the door. The
+    # disk rung (cold_tier_disk_capacity) exists to absorb exactly this
+    # overflow; a run with spills active is exempt only if the drops
+    # still found a disk slot (spills keep pace with drops).
+    ctrs = summary.get("ctrs", {})
+    drops = float(ctrs.get("cold_dropped", 0.0) or 0.0)
+    displ = float(ctrs.get("cold_displaced", 0.0) or 0.0)
+    spills = float(ctrs.get("cold_disk_spills", 0.0) or 0.0)
+    if drops > displ and spills < drops:
+        out.append(
+            f"cold_dropped: value={_n(drops)} > cold_displaced "
+            f"{_n(displ)} — door drops outrun displacements and disk "
+            f"spills ({_n(spills)}) did not absorb them: the cold "
+            f"store is thrashing; grow cold_tier_capacity or enable "
+            f"the disk rung (cold_tier_disk_capacity)")
     return out
 
 

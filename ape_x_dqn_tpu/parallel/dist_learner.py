@@ -445,6 +445,39 @@ class _DistLearnerBase:
                 rs, jax.tree.map(lambda x, j=j: x[j], items), td_abs[j])
         return state._replace(replay=rs)
 
+    # -- tiered cold store endpoints (runtime/driver.py eviction cycle;
+    # per-shard directed form — each shard evicts its OWN lowest-mass
+    # region, so the tier runs on the dp-sharded ring) -------------------
+
+    @partial(jax.jit, static_argnums=(0, 2))
+    def evict_region(self, state: DistTrainState, block: int):
+        """-> (start [dp], staging-layout items [dp, block, ...],
+        stored leaf priorities [dp, ...]) — shard d's lowest-priority-
+        mass `block`-unit region, planned independently per shard. NOT
+        donated: the driver fetches the result to host (ColdStore.put
+        per shard) before add_at overwrites the regions in place.
+        evict_plan/read_region are pure reads, so jax.vmap is safe
+        here — the scatter-rebatch hazard only bites donated in-place
+        writes (add_at below uses the unrolled per-shard DUS form)."""
+        def plan_read(rs):
+            start = self.replay.evict_plan(rs, block)
+            items, pri = self.replay.read_region(rs, start, block)
+            return start, items, pri
+        return jax.vmap(plan_read)(state.replay)
+
+    @partial(jax.jit, static_argnums=0, donate_argnums=1)
+    def add_at(self, state: DistTrainState, items: Any,
+               td_abs: jax.Array, start: jax.Array) -> DistTrainState:
+        """Directed ingest add: shard d overwrites its evict_region
+        start[d] instead of the lockstep FIFO cursor (cold tier on +
+        ring full; the default path never calls this)."""
+        items = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                jnp.asarray(x), self._dp_sharding), items)
+        return state._replace(
+            replay=self.replay.add_at_lockstep(state.replay, items,
+                                               td_abs, start))
+
     # -- weight publication (learner -> inference server over ICI) --------
 
     def publish_params(self, state: DistTrainState) -> Any:
@@ -508,7 +541,7 @@ class _DistLearnerBase:
         }
 
 
-class DistDQNLearner(_DistLearnerBase):  # apexlint: parity(no evict_region/add_at — the dp-sharded lockstep ring cannot run the cold tier yet; directed per-shard eviction is ROADMAP item 3's open work)
+class DistDQNLearner(_DistLearnerBase):
     """Flat n-step double-DQN over the mesh (SURVEY.md §3.3)."""
 
     def __init__(self, net_apply: Callable, replay: PrioritizedReplay,
@@ -527,7 +560,7 @@ class DistDQNLearner(_DistLearnerBase):  # apexlint: parity(no evict_region/add_
             discounts=items["discount"])
 
 
-class DistSequenceLearner(_DistLearnerBase):  # apexlint: parity(no evict_region/add_at — the dp-sharded lockstep ring cannot run the cold tier yet; directed per-shard eviction is ROADMAP item 3's open work)
+class DistSequenceLearner(_DistLearnerBase):
     """R2D2 stored-state sequences over the mesh (SURVEY.md §3.4; the
     r2d2 config attests dp=4 x tp=2).
 

@@ -87,7 +87,7 @@ class ColdStore:
 
     def __init__(self, item_spec: Any, capacity_transitions: int,
                  unit_items: int = 1, ptail: tuple = (),
-                 compress_level: int = 1):
+                 compress_level: int = 1, spill: Any = None):
         ok, detail = codec_status()
         if not ok:  # configs.py validation normally rejects this earlier
             raise RuntimeError(f"cold tier codec unavailable: {detail}")
@@ -98,6 +98,10 @@ class ColdStore:
         self.capacity = int(capacity_transitions)
         self.unit_items = int(unit_items)
         self.level = int(compress_level)
+        # optional disk rung (replay/disk_store.py): door losers —
+        # displaced victims and live door-dropped candidates — are
+        # offered there instead of vanishing. offer() never blocks.
+        self.spill = spill
         self._plan = cold_plan(item_spec, ptail)
         # ascending (mass_sum, seq): [0] is the next displacement
         # victim, [-1] the next recall
@@ -112,6 +116,7 @@ class ColdStore:
         self.dropped = 0
         self.displaced = 0
         self.recalled = 0
+        self.spilled = 0            # door losers offered to the disk rung
 
     # -- admission ---------------------------------------------------------
 
@@ -141,11 +146,25 @@ class ColdStore:
             victims += 1
         if self.transitions + live - freed > self.capacity:
             self.dropped += 1
+            if self.spill is not None:
+                # the candidate lost the RAM door but still carries
+                # live mass: pack it and offer it to the disk rung
+                # (non-blocking; a full queue loses it exactly as the
+                # drop would have)
+                payload, raw = cold_pack(dict(items, priorities=pri),
+                                         self._plan, self.level)
+                if self.spill.offer(ColdSegment(
+                        payload, n, int(live), raw, mass_sum, mass_max,
+                        self._seq)):
+                    self.spilled += 1
+                self._seq += 1
             return "dropped"
         for seg in self._segs[:victims]:
             self.transitions -= seg.live
             self.bytes_compressed -= len(seg.payload)
             self.bytes_raw -= seg.raw_bytes
+            if self.spill is not None and self.spill.offer(seg):
+                self.spilled += 1
         del self._segs[:victims], self._keys[:victims]
         self.displaced += victims
 
@@ -163,6 +182,57 @@ class ColdStore:
         self.bytes_raw += raw
         self.stored += 1
         return "stored"
+
+    def put_segment(self, seg: ColdSegment) -> str:
+        """Admit an already-packed segment (a disk promotion) through
+        the same door -> "stored" | "dropped". Displaced victims spill
+        back to disk, but a door-dropped CANDIDATE is intentionally
+        lost rather than re-spilled: re-offering a segment the door
+        just rejected would ping-pong it between the rungs forever
+        (the promote() floor makes this path rare — it only fires when
+        the floor rose mid-tick). Does NOT touch the eviction-door
+        stored/dropped counters: the driver's closure evicted ==
+        cold_stored + cold_dropped is denominated in ring evictions,
+        and promotions are not evictions."""
+        if seg.live <= 0 or seg.mass_sum <= 0.0:
+            return "dropped"
+        freed = 0
+        victims = 0
+        while (self.transitions + seg.live - freed > self.capacity
+               and victims < len(self._segs)
+               and self._keys[victims][0] < seg.mass_sum):
+            freed += self._segs[victims].live
+            victims += 1
+        if self.transitions + seg.live - freed > self.capacity:
+            return "dropped"
+        for victim in self._segs[:victims]:
+            self.transitions -= victim.live
+            self.bytes_compressed -= len(victim.payload)
+            self.bytes_raw -= victim.raw_bytes
+            if self.spill is not None and self.spill.offer(victim):
+                self.spilled += 1
+        del self._segs[:victims], self._keys[:victims]
+        self.displaced += victims
+        seg.seq = self._seq         # re-key in RAM admission order
+        self._seq += 1
+        key = (seg.mass_sum, seg.seq)
+        at = bisect.bisect(self._keys, key)
+        self._segs.insert(at, seg)
+        self._keys.insert(at, key)
+        self.transitions += seg.live
+        self.bytes_compressed += len(seg.payload)
+        self.bytes_raw += seg.raw_bytes
+        return "stored"
+
+    def displacement_floor(self) -> float:
+        """Minimum mass_sum a candidate needs to clear the door right
+        now: the lightest stored segment's mass when the store is full,
+        else 0.0 (free space admits anything live). The disk rung's
+        promote() uses this to skip segments — and whole files — that
+        would bounce."""
+        if not self._segs or self.transitions < self.capacity:
+            return 0.0
+        return self._keys[0][0]
 
     # -- recall ------------------------------------------------------------
 

@@ -63,7 +63,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ape_x_dqn_tpu.ops import sum_tree
-from ape_x_dqn_tpu.replay.packing import (dus_rows, frame_mode, pad128,
+from ape_x_dqn_tpu.replay.packing import (dus_rows, dus_rows_per_shard,
+                                          frame_mode, pad128,
                                           ring_write_size)
 from ape_x_dqn_tpu.replay.prioritized import (PrioritizedReplay,
                                               ReplayState, ring_cursor,
@@ -251,32 +252,49 @@ class FrameRingReplay(PrioritizedReplay):
         G*F frame rows / G*B transition slots per leading shard axis
         (in place on the donated state; a vmapped DUS would rebatch to
         a full-copy scatter — replay/packing.py), with skip-to-head
-        wrap at the segment cursor. A caller-supplied seg0 (add_at,
-        single-chip) directs the write at that segment instead."""
+        wrap at the segment cursor. A caller-supplied seg0 (add_at;
+        [dp]-vector seg0 under add_at_lockstep) directs the write at
+        that segment instead."""
         nl = len(lead)
         g = td_abs.shape[nl]
+        per_shard = False
         if seg0 is None:
             # cursor counts SEGMENTS, size counts transitions (size_scale)
             seg0, pos1, size1 = ring_cursor(state.pos, state.size, g,
                                             self.S, nl, size_scale=self.B)
         else:
-            assert nl == 0, "directed writes are single-chip only"
+            # directed write (add_at / add_at_lockstep). Dist form:
+            # seg0 is a [dp] vector (each shard's own evict_plan) and
+            # the cursor math is elementwise over shards.
+            per_shard = nl > 0
             pos1 = (seg0 + g) % self.S
             size1 = ring_write_size(state.size, seg0 * self.B,
                                     g * self.B, self.capacity)
-        tidx = seg0 * self.B + jnp.arange(g * self.B, dtype=jnp.int32)
+        if per_shard:
+            tidx = (seg0[:, None] * self.B
+                    + jnp.arange(g * self.B, dtype=jnp.int32)[None])
+        else:
+            tidx = seg0 * self.B + jnp.arange(g * self.B, dtype=jnp.int32)
         rows = items["seg_frames"].astype(self.obs_dtype) \
             .reshape(*lead, g * self.F, self.frame_bytes)
         if self.frame_row != self.frame_bytes:
             rows = jnp.pad(rows, [(0, 0)] * (nl + 1)
                            + [(0, self.frame_row - self.frame_bytes)])
         storage = dict(state.storage)
-        storage["frames"] = dus_rows(state.storage["frames"], rows,
-                                     seg0 * self.F, lead=nl)
-        for k in ("action", "reward", "discount", "next_off"):
-            storage[k] = dus_rows(state.storage[k],
-                                  items[k].reshape(*lead, g * self.B),
-                                  seg0 * self.B, lead=nl)
+        if per_shard:
+            storage["frames"] = dus_rows_per_shard(
+                state.storage["frames"], rows, seg0 * self.F)
+            for k in ("action", "reward", "discount", "next_off"):
+                storage[k] = dus_rows_per_shard(
+                    state.storage[k],
+                    items[k].reshape(*lead, g * self.B), seg0 * self.B)
+        else:
+            storage["frames"] = dus_rows(state.storage["frames"], rows,
+                                         seg0 * self.F, lead=nl)
+            for k in ("action", "reward", "discount", "next_off"):
+                storage[k] = dus_rows(state.storage[k],
+                                      items[k].reshape(*lead, g * self.B),
+                                      seg0 * self.B, lead=nl)
         valid = items["next_off"].reshape(*lead, g * self.B) > 0
         pri = jnp.where(
             valid,
@@ -343,6 +361,18 @@ class FrameRingReplay(PrioritizedReplay):
         evict_plan result) instead of the FIFO segment cursor."""
         return self._write_segments(state, items, td_abs, lead=(),
                                     seg0=seg0)
+
+    def add_at_lockstep(self, state: ReplayState, items: Any,
+                        td_abs: jax.Array,
+                        seg0: jax.Array) -> ReplayState:
+        """Directed segment add for [dp, ...]-stacked shard states:
+        shard d gets items[d] at segment seg0[d] (its own evict_plan
+        result). Per-shard unrolled DUS writes (dus_rows_per_shard);
+        shard cursors diverge, which is safe because the eviction swap
+        only runs on a full ring — the lockstep FIFO cursor is never
+        consulted again (see PrioritizedReplay.add_at_lockstep)."""
+        return self._write_segments(state, items, td_abs,
+                                    lead=(td_abs.shape[0],), seg0=seg0)
 
     def _gather(self, state: ReplayState, idx: jax.Array) -> dict:
         """Reconstruct flat transitions {obs, action, reward, next_obs,

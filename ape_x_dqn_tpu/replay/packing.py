@@ -129,6 +129,26 @@ def dus_rows(buf: jax.Array, block: jax.Array, start: jax.Array,
     return jax.lax.dynamic_update_slice(buf, block.astype(buf.dtype), idx)
 
 
+def dus_rows_per_shard(buf: jax.Array, block: jax.Array,
+                       starts: jax.Array) -> jax.Array:
+    """Per-shard directed ring write: shard d of a [dp, capacity, ...]
+    buffer gets block[d] at row starts[d] — the dist form of the cold
+    tier's add_at, where each shard's evict_plan picked its OWN region.
+
+    dp single-shard multi-axis DUS calls, unrolled (dp is static).
+    Chained DUS into a donated buffer alias in place; the obvious
+    jax.vmap over the shard axis would rebatch the DUS into a
+    lax.scatter and materialize a full-buffer copy (see dus_rows)."""
+    dp = block.shape[0]
+    out = buf
+    for d in range(dp):
+        idx = ((jnp.int32(d), starts[d])
+               + (jnp.int32(0),) * (buf.ndim - 2))
+        out = jax.lax.dynamic_update_slice(
+            out, block[d:d + 1].astype(buf.dtype), idx)
+    return out
+
+
 def packable(spec) -> bool:
     """Pack uint8 pixel leaves big enough that tile padding matters.
 

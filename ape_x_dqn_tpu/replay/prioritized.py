@@ -24,7 +24,8 @@ import jax.numpy as jnp
 
 from ape_x_dqn_tpu.ops import sum_tree
 from ape_x_dqn_tpu.replay.packing import (PixelPacker, dus_rows,
-                                          make_packer, ring_write_size,
+                                          dus_rows_per_shard, make_packer,
+                                          ring_write_size,
                                           ring_write_start)
 
 
@@ -54,14 +55,21 @@ def ring_cursor(pos, size, block: int, capacity: int, nl: int,
 def ring_finish(tree, idx, pri, pos1, size1, lead: tuple[int, ...]):
     """Tree write-back + cursor broadcast shared by every ring layout:
     single-chip (lead=()) updates the one tree; the lockstep-dist form
-    vmaps the small per-shard trees (the storage itself was already
-    written with one multi-axis DUS) and broadcasts the common cursor
-    to [dp] vectors. -> (tree, pos, size)."""
+    (idx [b], same every shard) vmaps the small per-shard trees (the
+    storage itself was already written with one multi-axis DUS) and
+    broadcasts the common cursor to [dp] vectors; the DIRECTED dist
+    form (idx [dp, b], each shard's evict_plan picked its own region)
+    vmaps tree AND indices and passes the per-shard [dp] cursors
+    through. -> (tree, pos, size)."""
     if not lead:
         return sum_tree.update(tree, idx, pri), pos1, size1
-    tree = jax.vmap(sum_tree.update, in_axes=(0, None, 0))(tree, idx, pri)
-    return (tree, jnp.full(lead, pos1, jnp.int32),
-            jnp.full(lead, size1, jnp.int32))
+    if idx.ndim == 1:
+        tree = jax.vmap(sum_tree.update,
+                        in_axes=(0, None, 0))(tree, idx, pri)
+        return (tree, jnp.full(lead, pos1, jnp.int32),
+                jnp.full(lead, size1, jnp.int32))
+    tree = jax.vmap(sum_tree.update, in_axes=(0, 0, 0))(tree, idx, pri)
+    return tree, pos1.astype(jnp.int32), size1.astype(jnp.int32)
 
 
 class PrioritizedReplay:
@@ -126,22 +134,34 @@ class PrioritizedReplay:
         through vmap on the lockstep path."""
         nl = len(lead)
         b = td_abs.shape[nl]
+        per_shard = False
         if start is None:
             start, pos1, size1 = ring_cursor(state.pos, state.size, b,
                                              self.capacity, nl)
         else:
-            # directed write (add_at, single-chip): overwrite the caller-
-            # chosen region; the cursor resumes after it so subsequent
-            # FIFO adds don't immediately clobber what was just written
-            assert nl == 0, "directed writes are single-chip only"
+            # directed write (add_at / add_at_lockstep): overwrite the
+            # caller-chosen region; the cursor resumes after it so
+            # subsequent FIFO adds don't immediately clobber what was
+            # just written. Dist form: start is a [dp] vector (each
+            # shard's evict_plan picked its own region) and the cursor
+            # math is elementwise over shards.
+            per_shard = nl > 0
             pos1 = (start + b) % self.capacity
             size1 = ring_write_size(state.size, start, b, self.capacity)
-        idx = start + jnp.arange(b, dtype=jnp.int32)  # same every shard
+        if per_shard:
+            idx = start[:, None] + jnp.arange(b, dtype=jnp.int32)[None]
+        else:
+            idx = start + jnp.arange(b, dtype=jnp.int32)  # every shard
         if self._packer is not None:
             items = self._packer.encode(items)
-        storage = jax.tree.map(
-            lambda buf, x: dus_rows(buf, x, start, lead=nl),
-            state.storage, items)
+        if per_shard:
+            storage = jax.tree.map(
+                lambda buf, x: dus_rows_per_shard(buf, x, start),
+                state.storage, items)
+        else:
+            storage = jax.tree.map(
+                lambda buf, x: dus_rows(buf, x, start, lead=nl),
+                state.storage, items)
         pri = (td_abs + self.eps) ** self.alpha
         tree, pos, size = ring_finish(state.tree, idx, pri, pos1, size1,
                                       lead)
@@ -217,6 +237,21 @@ class PrioritizedReplay:
         evict_plan result) instead of the FIFO cursor position."""
         return self._write_block(state, items, td_abs, lead=(),
                                  start=start)
+
+    def add_at_lockstep(self, state: ReplayState, items: Any,
+                        td_abs: jax.Array,
+                        start: jax.Array) -> ReplayState:
+        """Directed `add_lockstep`: shard d of the [dp, ...]-stacked
+        state gets items[d] at start[d] (each shard's own evict_plan
+        result — the dp form of the cold tier's eviction swap). Writes
+        are dp unrolled single-shard DUS calls (dus_rows_per_shard);
+        shard cursors DIVERGE here, which is safe because the eviction
+        swap only runs once the ring is full — every subsequent ship
+        routes back through evict_plan/add_at, so the lockstep FIFO
+        cursor is never consulted again (pinned by
+        tests/test_ingest.py's dp=2 cold closure test)."""
+        return self._write_block(state, items, td_abs,
+                                 lead=(td_abs.shape[0],), start=start)
 
     def sample_items(self, state: ReplayState, rng: jax.Array, batch: int
                      ) -> tuple[Any, jax.Array, jax.Array]:

@@ -351,18 +351,24 @@ class ApexDriver:
         # tests/test_ingest.py (door outcomes — displacements of
         # already-stored segments are the store's own counter).
         self._cold: ColdStore | None = None
+        self._disk = None        # disk-spill rung (replay/disk_store.py)
         self._cold_evicted = 0   # ingest thread only
         self._cold_stored = 0    # ingest thread only
         self._cold_dropped = 0   # ingest thread only
         self._cold_recalled = 0  # ingest thread only
+        # the same door outcomes attributed per dp shard (the dist
+        # eviction swap runs per shard, so the closure holds per shard:
+        # evicted[d] == stored[d] + dropped[d] — the PR-9
+        # ingest_dropped_per_shard idiom extended to the cold door)
+        self._cold_evicted_per_shard = np.zeros(self.dp, np.int64)
+        self._cold_stored_per_shard = np.zeros(self.dp, np.int64)
+        self._cold_dropped_per_shard = np.zeros(self.dp, np.int64)
+        # last-seen store counters for delta-emitted obs ctrs
+        self._cold_dropped_seen = 0
+        self._cold_displaced_seen = 0
+        self._disk_seen: dict = {}
         cold_cap = getattr(cfg.replay, "cold_tier_capacity", 0)
         if cold_cap > 0:
-            if self.is_dist:
-                raise NotImplementedError(
-                    "replay.cold_tier_capacity > 0 is single-chip only "
-                    "for now — the dp-sharded lockstep ring has no "
-                    "directed per-shard eviction write; run dp=tp=1 or "
-                    "set cold_tier_capacity=0")
             if self.family != "dqn" or not getattr(
                     self.replay, "has_priorities", False):
                 raise NotImplementedError(
@@ -375,11 +381,25 @@ class ApexDriver:
                     "the cold tier refills through the zero-copy ingest "
                     "stager — replay.ingest_zero_copy=False and "
                     "cold_tier_capacity > 0 are incompatible")
+            disk_cap = getattr(cfg.replay, "cold_tier_disk_capacity", 0)
+            if disk_cap > 0:
+                from ape_x_dqn_tpu.replay.disk_store import DiskStore
+                self._disk = DiskStore(
+                    cfg.replay.cold_tier_disk_dir, disk_cap,
+                    queue_depth=getattr(cfg.replay,
+                                        "cold_tier_disk_queue", 16),
+                    file_bytes=getattr(cfg.replay,
+                                       "cold_tier_disk_file_bytes",
+                                       64 * 1024 * 1024),
+                    compact_frac=getattr(cfg.replay,
+                                         "cold_tier_disk_compact_frac",
+                                         0.5))
             self._cold = ColdStore(
                 item_spec, cold_cap, unit_items=self._unit_items,
                 ptail=ptail,
                 compress_level=getattr(cfg.replay,
-                                       "cold_tier_compress_level", 1))
+                                       "cold_tier_compress_level", 1),
+                spill=self._disk)
         # profiler capture state: False = armed, True = tracing,
         # None = finished/disabled (single capture per run)
         self._profiling: bool | None = False if cfg.profile_dir else None
@@ -978,24 +998,35 @@ class ApexDriver:
         return handles
 
     def _ship_staged_cold(self, views: dict, g: int) -> list:
-        """Eviction-swap ship (cold tier on, ring full, single-chip):
-        per staged block, the jitted evict_region picks the ring's
-        lowest-priority-mass region and reads it out in staging layout;
-        the region is fetched to host (a sync — the directed add_at
-        aliases those buffers in place a line later), compressed into
-        the ColdStore, and the fresh block overwrites exactly that
-        region via add_at. Blocks are swapped one at a time (not the
-        coalesced add_many) because each one's eviction plan must see
-        the tree the previous swap produced."""
+        """Eviction-swap ship (cold tier on, ring full): per staged
+        block, the jitted evict_region picks the ring's lowest-
+        priority-mass region and reads it out in staging layout; the
+        region is fetched to host (a sync — the directed add_at aliases
+        those buffers in place a line later), compressed into the
+        ColdStore, and the fresh block overwrites exactly that region
+        via add_at. Blocks are swapped one at a time (not the coalesced
+        add_many) because each one's eviction plan must see the tree
+        the previous swap produced. On the mesh each shard runs its own
+        plan: evict_region returns [dp] starts / [dp, chunk, ...]
+        regions, each shard's region goes through the door as its own
+        segment, and the door outcomes are attributed per shard so the
+        closure evicted[d] == stored[d] + dropped[d] holds exactly."""
         chunk = self._stage_chunk
         handles = []
         for j in range(g):
-            block = {k: v[j * chunk:(j + 1) * chunk]
+            block = {k: v[j * chunk * self.dp:(j + 1) * chunk * self.dp]
                      for k, v in views.items()}
-            staged = {k: jax.device_put(v) for k, v in block.items()}
+            if self.is_dist:
+                staged = {k: jax.device_put(
+                    v.reshape((self.dp, chunk) + v.shape[1:]),
+                    self.learner._dp_sharding)
+                    for k, v in block.items()}
+            else:
+                staged = {k: jax.device_put(v) for k, v in block.items()}
             pris = staged.pop("priorities")
             with self._state_lock:
-                with self.obs.span("replay.evict", units=chunk):
+                with self.obs.span("replay.evict",
+                                   units=chunk * self.dp):
                     start, ev_items, ev_pri = self.learner.evict_region(
                         self.state, chunk)
                     # host fetch BEFORE the donated overwrite deletes
@@ -1005,15 +1036,35 @@ class ApexDriver:
                     ev_pri = np.asarray(ev_pri)
                     self.state = self.learner.add_at(self.state, staged,
                                                      pris, start)
-            live = int((ev_pri > 0).sum())
-            self._cold_evicted += live
-            if self._cold.put(ev_host, ev_pri, live) == "stored":
-                self._cold_stored += live
+            if self.is_dist:
+                for d in range(self.dp):
+                    pri_d = ev_pri[d]
+                    live = int((pri_d > 0).sum())
+                    self._cold_evicted += live
+                    self._cold_evicted_per_shard[d] += live
+                    status = self._cold.put(
+                        {k: v[d] for k, v in ev_host.items()},
+                        pri_d, live)
+                    if status == "stored":
+                        self._cold_stored += live
+                        self._cold_stored_per_shard[d] += live
+                    else:
+                        self._cold_dropped += live
+                        self._cold_dropped_per_shard[d] += live
+                    self.obs.count("cold_evictions")
             else:
-                self._cold_dropped += live
-            self.obs.count("cold_evictions")
+                live = int((ev_pri > 0).sum())
+                self._cold_evicted += live
+                self._cold_evicted_per_shard[0] += live
+                if self._cold.put(ev_host, ev_pri, live) == "stored":
+                    self._cold_stored += live
+                    self._cold_stored_per_shard[0] += live
+                else:
+                    self._cold_dropped += live
+                    self._cold_dropped_per_shard[0] += live
+                self.obs.count("cold_evictions")
             handles += list(staged.values()) + [pris]
-        self.ingest_rows.add(g * chunk * self._unit_items)
+        self.ingest_rows.add(g * chunk * self.dp * self._unit_items)
         # _replay_filled stays at capacity: eviction swaps slots 1:1
         self.obs.gauge("ingest_coalesce_width", g)
         self._emit_cold_gauges()
@@ -1026,21 +1077,39 @@ class ApexDriver:
         path re-applies (|td|+eps)^alpha at write time), and restage
         them through the normal stager so recalled data rides the same
         one-copy staging->add path as fresh actor experience."""
-        if self._cold is None or not len(self._cold):
+        if self._cold is None:
             return
-        k = getattr(self.cfg.replay, "cold_tier_refill", 1)
-        if k <= 0:
-            return
-        alpha, eps = self.replay.alpha, self.replay.eps
-        for batch in self._cold.recall(k):
-            pri = np.asarray(batch["priorities"], np.float32)
-            td = np.maximum(pri ** (1.0 / alpha) - eps, 0.0) \
-                .astype(np.float32)
-            batch = dict(batch, priorities=td)
-            self._stager.put(batch)
-            self._cold_recalled += int((pri > 0).sum())
-            self.obs.count("cold_recalls")
-        self._emit_cold_gauges()
+        # bound the restage burst to what the active staging buffer can
+        # absorb without shipping: a recalled/promoted segment is at
+        # most one stage_chunk of units (the eviction block), so `room`
+        # segments fit without forcing a synchronous mid-idle dispatch
+        room = self._stager.free_units() // max(1, self._stage_chunk)
+        k = min(getattr(self.cfg.replay, "cold_tier_refill", 1), room)
+        did = False
+        if k > 0 and len(self._cold):
+            alpha, eps = self.replay.alpha, self.replay.eps
+            for batch in self._cold.recall(k):
+                pri = np.asarray(batch["priorities"], np.float32)
+                td = np.maximum(pri ** (1.0 / alpha) - eps, 0.0) \
+                    .astype(np.float32)
+                batch = dict(batch, priorities=td)
+                self._stager.put(batch)
+                self._cold_recalled += int((pri > 0).sum())
+                self.obs.count("cold_recalls")
+            did = True
+        # disk promotions AFTER recalls: the heaviest disk segments
+        # climb back through the RAM door (put_segment — its displaced
+        # victims spill back down), gated on the door's current floor
+        # so a promotion never bounces (replay/disk_store.py)
+        if self._disk is not None:
+            kd = getattr(self.cfg.replay, "cold_tier_disk_promote", 1)
+            if kd > 0:
+                floor = self._cold.displacement_floor()
+                for seg in self._disk.promote(kd, floor):
+                    self._cold.put_segment(seg)
+                    did = True
+        if did:
+            self._emit_cold_gauges()
 
     def _emit_cold_gauges(self) -> None:
         cold = self._cold
@@ -1048,6 +1117,44 @@ class ApexDriver:
         self.obs.gauge("cold_bytes", float(cold.bytes_compressed))
         self.obs.gauge("cold_compression_ratio",
                        cold.compression_ratio())
+        # door outcomes as delta-emitted ctrs: report --check warns
+        # when drops outrun displacements (store thrashing — the signal
+        # the disk rung exists to absorb)
+        d = cold.dropped - self._cold_dropped_seen
+        if d:
+            self.obs.count("cold_dropped", d)
+            self._cold_dropped_seen = cold.dropped
+        d = cold.displaced - self._cold_displaced_seen
+        if d:
+            self.obs.count("cold_displaced", d)
+            self._cold_displaced_seen = cold.displaced
+        if self._disk is None:
+            return
+        s = self._disk.stats()
+        self.obs.gauge("cold_disk_segments", float(s["segments"]))
+        self.obs.gauge("cold_disk_transitions", float(s["transitions"]))
+        self.obs.gauge("cold_disk_bytes", float(s["bytes"]))
+
+        def delta(key: str) -> int:
+            d = s[key] - self._disk_seen.get(key, 0)
+            if d:
+                self._disk_seen[key] = s[key]
+            return d
+
+        # literal metric names (not a name loop): the obs-names checker
+        # matches emission sites to INSTRUMENTS rows by string literal
+        d = delta("spilled")
+        if d:
+            self.obs.count("cold_disk_spills", d)
+        d = delta("promoted")
+        if d:
+            self.obs.count("cold_disk_promotions", d)
+        d = delta("queue_full")
+        if d:
+            self.obs.count("cold_disk_queue_full", d)
+        d = delta("io_errors")
+        if d:
+            self.obs.count("cold_disk_errors", d)
 
     def _add_block(self, take: dict, count: int) -> None:
         """count is in staging units; priorities reshape like items (they
@@ -1201,13 +1308,16 @@ class ApexDriver:
         self.obs.log_compiled("add", c_add)
         self.obs.log_compiled("train_step", c_step)
         if self._cold is not None:
-            # the eviction-swap path's two graphs (single-chip shapes):
-            # a first-dispatch compile here would otherwise hold
-            # _state_lock mid-ship exactly when the ring first fills
+            # the eviction-swap path's two graphs: a first-dispatch
+            # compile here would otherwise hold _state_lock mid-ship
+            # exactly when the ring first fills. Dist add_at takes a
+            # [dp] start vector (per-shard directed writes)
+            start0 = (jnp.zeros((self.dp,), jnp.int32) if self.is_dist
+                      else jnp.int32(0))
             c_ev = cls.evict_region.lower(
                 learner, self.state, self._stage_chunk).compile()
             c_addat = cls.add_at.lower(learner, self.state, example,
-                                       pris, jnp.int32(0)).compile()
+                                       pris, start0).compile()
             self.obs.log_compiled("evict_region", c_ev)
             self.obs.log_compiled("add_at", c_addat)
         if self._stager is not None and self._stager.coalesce > 1:
@@ -1647,6 +1757,17 @@ class ApexDriver:
                 except Exception as e:
                     self.loop_errors.append(("checkpoint", e))
             self.server.stop()
+            if self._disk is not None:
+                # let queued spills land before the thread stops; a
+                # hard kill here is exactly what the recovery scan is
+                # for, so failures are logged, never raised
+                try:
+                    self._disk.drain(timeout=5.0)
+                except TimeoutError as e:
+                    # queued spills that never landed are lost segments
+                    self.obs.count("cold_disk_errors")
+                    self.loop_errors.append(("disk_drain", e))
+                self._disk.close()
             # final snapshot + trace flush (idempotent: the stall path
             # already closed inside check_stalled before raising)
             self.obs.close(self._grad_steps_total)
@@ -1687,7 +1808,17 @@ class ApexDriver:
                 "transitions": self._cold.transitions,
                 "bytes": self._cold.bytes_compressed,
                 "compression_ratio": self._cold.compression_ratio(),
+                # per-shard closure: evicted[d] == stored[d] +
+                # dropped[d] for every shard (dp=1 single-chip)
+                "evicted_per_shard":
+                    self._cold_evicted_per_shard.tolist(),
+                "stored_per_shard":
+                    self._cold_stored_per_shard.tolist(),
+                "dropped_per_shard":
+                    self._cold_dropped_per_shard.tolist(),
             }
+            if self._disk is not None:
+                out["cold_tier"]["disk"] = self._disk.stats()
         if self.is_dist:
             # teardown-time per-shard fill/mass: the state is quiescent
             # (all loops joined above), so the device fetch is safe

@@ -217,3 +217,12 @@ class IngestStager:
     def occupancy(self) -> float:
         """Fill fraction of the active staging buffer (obs gauge)."""
         return self._cursor / self.rows
+
+    def free_units(self) -> int:
+        """Rows the active buffer absorbs before a put triggers the
+        coalesced ship. The cold tier's idle refill tick bounds its
+        recall/promotion burst to this so restaging recalled segments
+        never forces a synchronous mid-idle add_many dispatch (which
+        would take _state_lock against train_many — the contention the
+        idle tick exists to avoid)."""
+        return self.rows - self._cursor

@@ -2212,6 +2212,278 @@ def bench_tiered_ab(args) -> None:
     raise SystemExit(rc)
 
 
+def _tiered_disk_artifact_path(smoke: bool) -> str:
+    """Artifact of record for the tiered lane's disk arm. Same
+    smoke/full split as every other lane."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "TIERED_DISK_SMOKE.json" if smoke
+                        else "TIERED_DISK_LATEST.json")
+
+
+def _load_tiered_disk_baseline(smoke: bool, storage: str, capacity: int,
+                               cold_capacity: int
+                               ) -> tuple[str | None, dict | None]:
+    """Newest COMPARABLE disk-arm artifact: same smoke class, same
+    storage layout, same ring AND cold capacities. The on-arm
+    grad-steps/s bakes in both the eviction-block geometry and the
+    spill pressure (cold capacity sets when the door starts handing
+    segments to the writeback queue)."""
+    path = _tiered_disk_artifact_path(smoke)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    if not (isinstance(doc, dict) and "metric" in doc
+            and "value" in doc):
+        return None, None
+    if (doc.get("storage") != storage
+            or doc.get("capacity") != capacity
+            or doc.get("cold_capacity") != cold_capacity):
+        log(f"tiered-disk gate: {os.path.basename(path)} is "
+            f"{doc.get('storage')}@{doc.get('capacity')}/"
+            f"{doc.get('cold_capacity')}, this run is "
+            f"{storage}@{capacity}/{cold_capacity} — not comparable, "
+            f"skipped")
+        return None, None
+    return path, doc
+
+
+def bench_tiered_disk(args) -> None:
+    """Disk arm of the tiered lane (--tiered-ab --tiered-disk, PR 16):
+    grad-steps/s with every ingest block riding the eviction swap AND
+    the cold store's admission-door losers spilling to the disk rung's
+    async writeback (replay/disk_store.py) — vs the identical swap
+    loop with the spill hook off. Both arms run with the cold store
+    already AT capacity so the door (and hence the spill traffic) is
+    live for every timed dispatch; the A/B therefore isolates exactly
+    what the disk rung adds to the ship path, which by construction is
+    one bounded put_nowait per door loser (queue_full counts refusals
+    — the ship path never waits on disk).
+
+    Then a retention soak: keep swapping on the spill-on store until
+    the DISK holds --tiered-disk-mult x the cold tier's transitions
+    (the 10^8-retention story at bench scale: ring << RAM cold <<
+    disk), drain the writeback queue, and measure promote() readback
+    throughput. Artifact: TIERED_DISK_LATEST.json
+    (TIERED_DISK_SMOKE.json under --smoke); --perf-gate gates gsps_on
+    against the newest comparable artifact with the anti-ratchet rule
+    (a failing run never becomes the baseline)."""
+    import shutil
+    import tempfile
+
+    from ape_x_dqn_tpu.replay.cold_store import ColdStore, codec_status
+    from ape_x_dqn_tpu.replay.disk_store import DiskStore
+    from ape_x_dqn_tpu.replay.frame_ring import frame_segment_spec
+    from ape_x_dqn_tpu.runtime.learner import transition_item_spec
+
+    capacity, batch, storage = args.capacity, args.batch_size, args.storage
+    net, learner, state, spec = build_learner(capacity, batch, storage,
+                                              args.sample_chunk)
+    replay = learner.replay
+    rng = np.random.default_rng(7)
+    block_tr = max(min(args.tiered_block, capacity // 4), 1)
+    if storage == "frame_ring":
+        block_units = max(block_tr // replay.B, 1)
+        block_tr = block_units * replay.B
+        unit_items = replay.B
+        item_spec = frame_segment_spec(replay.B, replay.n,
+                                       spec.obs_shape, spec.obs_dtype)
+        ptail = (replay.B,)
+        host_items, host_pris = _tiered_seg_chunk(replay, spec,
+                                                  block_units, rng)
+    else:
+        block_units = block_tr
+        unit_items = 1
+        item_spec = transition_item_spec(spec.obs_shape, spec.obs_dtype)
+        ptail = ()
+        host_items, host_pris = _tiered_flat_chunk(spec, block_tr, rng)
+    # a SMALL cold tier relative to the soak target: the disk arm's
+    # whole point is retention far beyond RAM, so the RAM rung here is
+    # 2x the ring and the disk must end up holding
+    # --tiered-disk-mult x that
+    cold_cap = args.tiered_cold_capacity or 2 * capacity
+    target = int(args.tiered_disk_mult * cold_cap)
+    disk_cap = 2 * target  # headroom: the disk door must never gate
+    #                        the retention criterion itself
+    disk_dir = tempfile.mkdtemp(prefix="tiered_disk_")
+    disk = DiskStore(disk_dir, disk_cap,
+                     queue_depth=args.tiered_disk_queue)
+    cold_off = ColdStore(item_spec, cold_cap, unit_items=unit_items,
+                         ptail=ptail, compress_level=1)
+    cold_on = ColdStore(item_spec, cold_cap, unit_items=unit_items,
+                        ptail=ptail, compress_level=1, spill=disk)
+    log(f"tiered-disk: codec {codec_status()[1]}, ring {capacity} "
+        f"transitions ({storage}), cold {cold_cap}, disk capacity "
+        f"{disk_cap} (target {target}), block {block_tr} transitions")
+
+    def put_block():
+        staged = {k: jax.device_put(v) for k, v in host_items.items()}
+        return staged, jax.device_put(host_pris)
+
+    for _ in range(max(capacity // block_tr, 1)):
+        staged, pris = put_block()
+        state = learner.add(state, staged, pris)
+    jax.block_until_ready(state.replay.tree)
+
+    t0 = time.monotonic()
+    state, m = learner.train_many(state, args.steps_per_dispatch)
+    jax.block_until_ready(m["loss"])
+    start, _ev_items, ev_pri = learner.evict_region(state, block_units)
+    np.asarray(ev_pri)
+    staged, pris = put_block()
+    state = learner.add_at(state, staged, pris, start)
+    jax.block_until_ready(state.replay.tree)
+    log(f"tiered-disk compile+warmup: {time.monotonic() - t0:.1f}s")
+
+    def swap_once(state, store):
+        staged, pris = put_block()
+        start, ev_items, ev_pri = learner.evict_region(state,
+                                                       block_units)
+        ev_host = {k: np.asarray(v) for k, v in ev_items.items()}
+        ev_pri = np.asarray(ev_pri)
+        state = learner.add_at(state, staged, pris, start)
+        live = int((ev_pri > 0).sum())
+        store.put(ev_host, ev_pri, live)
+        return state
+
+    # fill BOTH cold stores to capacity first so every timed dispatch
+    # runs with the admission door live — in the on arm that means
+    # spill traffic on every put, the worst case for the ship path
+    for store in (cold_off, cold_on):
+        fills = 0
+        while store.transitions < cold_cap \
+                and fills < 4 * (cold_cap // block_tr + 1):
+            state = swap_once(state, store)
+            fills += 1
+    jax.block_until_ready(state.replay.tree)
+
+    steps, dispatches = args.steps_per_dispatch, args.dispatches
+    off_rates, on_rates = [], []
+    for _ in range(args.repeats):
+        t0 = time.monotonic()
+        for _ in range(dispatches):
+            state = swap_once(state, cold_off)
+            state, m = learner.train_many(state, steps)
+        jax.block_until_ready(m["loss"])
+        off_rates.append(steps * dispatches / (time.monotonic() - t0))
+        t0 = time.monotonic()
+        for _ in range(dispatches):
+            state = swap_once(state, cold_on)
+            state, m = learner.train_many(state, steps)
+        jax.block_until_ready(m["loss"])
+        on_rates.append(steps * dispatches / (time.monotonic() - t0))
+    gsps_off = float(np.median(off_rates))
+    gsps_on = float(np.median(on_rates))
+    on_off = gsps_on / gsps_off if gsps_off else 0.0
+    log(f"tiered-disk A/B: off {spread(off_rates)} vs on "
+        f"{spread(on_rates)} grad-steps/s (on/off {on_off:.3f})")
+
+    # retention soak: spill until the DISK holds the target multiple
+    # of the cold tier's capacity (writeback is async, so poll the
+    # store's own transition count, not the swap count)
+    max_swaps = 8 * (target // block_tr + 1)
+    swaps = 0
+    t0 = time.monotonic()
+    while disk.transitions < target and swaps < max_swaps:
+        state = swap_once(state, cold_on)
+        swaps += 1
+        if swaps % 16 == 0:
+            # let a deep backlog land; offer() itself never waits
+            time.sleep(0.01)
+    try:
+        disk.drain(timeout=60.0)
+    except TimeoutError:
+        log("tiered-disk: writeback drain timed out — counting what "
+            "landed")
+    soak_s = time.monotonic() - t0
+    dstats = disk.stats()
+    retention = dstats["transitions"] / cold_cap if cold_cap else 0.0
+    log(f"tiered-disk soak: {swaps} swaps -> {dstats['transitions']} "
+        f"disk transitions in {dstats['segments']} segments across "
+        f"{dstats['files']} files in {soak_s:.1f}s (retention "
+        f"{retention:.2f}x cold, queue_full {dstats['queue_full']}, "
+        f"io_errors {dstats['io_errors']})")
+
+    # promote readback: heaviest segments off disk, CRC-checked
+    rec_segments = min(dstats["segments"], 32)
+    t0 = time.monotonic()
+    promoted = disk.promote(rec_segments, floor=0.0)
+    rec_s = time.monotonic() - t0
+    rec_items = sum(s.live for s in promoted)
+    promote_items_per_s = rec_items / rec_s if rec_s else 0.0
+    log(f"tiered-disk promote: {len(promoted)} segments, {rec_items} "
+        f"live transitions in {rec_s:.2f}s ({promote_items_per_s:,.0f} "
+        f"items/s off disk)")
+    disk.close()
+    shutil.rmtree(disk_dir, ignore_errors=True)
+
+    ok = (retention >= args.tiered_disk_mult
+          and dstats["io_errors"] == 0
+          and dstats["corrupt_segments"] == 0)
+    result = {
+        "metric": "tiered_disk_grad_steps_per_s_on",
+        "value": float(f"{gsps_on:.4g}"),
+        "unit": "steps/s",
+        "ok": ok,
+        "smoke": bool(args.smoke),
+        "storage": storage,
+        "capacity": capacity,
+        "cold_capacity": cold_cap,
+        "disk_capacity": disk_cap,
+        "batch": batch,
+        "block_transitions": block_tr,
+        "codec": codec_status()[1],
+        "grad_steps_per_s_off": spread(off_rates),
+        "grad_steps_per_s_on": spread(on_rates),
+        "on_off_frac": round(on_off, 4),
+        "within_5pct": bool(on_off >= 0.95),
+        "disk_transitions": dstats["transitions"],
+        "disk_segments": dstats["segments"],
+        "disk_files": dstats["files"],
+        "disk_bytes": dstats["bytes"],
+        "retention_vs_cold": round(retention, 3),
+        "retention_target": float(args.tiered_disk_mult),
+        "spilled": dstats["spilled"],
+        "disk_dropped": dstats["dropped"],
+        "queue_full": dstats["queue_full"],
+        "io_errors": dstats["io_errors"],
+        "corrupt_segments": dstats["corrupt_segments"],
+        "compactions": dstats["compactions"],
+        "promote_items_per_s": round(promote_items_per_s, 1),
+        "door": {"stored": cold_on.stored, "dropped": cold_on.dropped,
+                 "displaced": cold_on.displaced,
+                 "spilled": cold_on.spilled},
+    }
+    line = json.dumps(result)
+    gated = getattr(args, "perf_gate", False)
+    rc = 0
+    if gated:
+        args._baseline = _load_tiered_disk_baseline(
+            args.smoke, storage, capacity, cold_cap)
+        rc = _gate_exit(result, args)
+    if not ok:
+        log(f"tiered-disk: criteria NOT met (retention "
+            f"{retention:.2f}x vs >= {args.tiered_disk_mult}x cold "
+            f"capacity, io_errors {dstats['io_errors']}, corrupt "
+            f"{dstats['corrupt_segments']})")
+        rc = rc or 1
+    if rc == 0 or not gated:
+        if ok:
+            path = _tiered_disk_artifact_path(args.smoke)
+            try:
+                with open(path, "w") as fh:
+                    fh.write(line + "\n")
+            except OSError as e:
+                log(f"could not write tiered-disk artifact {path}: "
+                    f"{e!r}")
+    else:
+        log("tiered-disk perf-gate: artifact of record NOT updated by "
+            "this failing run")
+    print(line, flush=True)
+    raise SystemExit(rc)
+
+
 def _serve_artifact_path(smoke: bool) -> str:
     """Artifact of record for the serving lane. Same smoke/full split
     as the main bench: a CI smoke run only ever gates against a smoke
@@ -2683,6 +2955,23 @@ def main() -> None:
                    help="capacity-soak target: the cold tier must end "
                    "up holding this multiple of the ring's transitions "
                    "(8 = the tiering acceptance bar)")
+    p.add_argument("--tiered-disk", action="store_true",
+                   help="with --tiered-ab: run the DISK arm instead "
+                   "(replay/disk_store.py, PR 16): the same eviction-"
+                   "swap loop with the cold store's admission-door "
+                   "losers spilling to the async disk writeback vs "
+                   "spill off, plus a retention soak (disk must hold "
+                   "--tiered-disk-mult x the cold tier's capacity) "
+                   "and promote() readback throughput. Writes "
+                   "TIERED_DISK_LATEST.json (TIERED_DISK_SMOKE.json "
+                   "under --smoke; PERF.md 'Disk tier')")
+    p.add_argument("--tiered-disk-mult", type=float, default=8.0,
+                   help="disk-arm retention target: the disk rung "
+                   "must end up holding this multiple of the cold "
+                   "tier's transitions (8 = the acceptance bar)")
+    p.add_argument("--tiered-disk-queue", type=int, default=16,
+                   help="writeback queue depth for the disk arm "
+                   "(full-queue offers are counted, never waited on)")
     p.add_argument("--serve-ab", action="store_true",
                    help="run the multi-tenant serving A/B INSTEAD of "
                    "the main bench (parallel/inference_server.py "
@@ -2805,7 +3094,10 @@ def main() -> None:
         bench_learn_health(args)
         return
     if args.tiered_ab:
-        bench_tiered_ab(args)
+        if args.tiered_disk:
+            bench_tiered_disk(args)
+        else:
+            bench_tiered_ab(args)
         return
     if args.serve_ab:
         bench_serve_ab(args)

@@ -1,0 +1,12 @@
+"""retry-annotation fixture (replay scope, PR 16): a swallowed
+OSError on a disk-spill write path with no counter, no accounting
+bump, and no waiver — a silently lost replay segment."""
+
+
+class SpillStore:
+    def append(self, fh, payload):
+        try:
+            fh.write(payload)
+            fh.flush()
+        except OSError:
+            pass

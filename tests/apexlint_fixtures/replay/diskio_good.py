@@ -1,0 +1,33 @@
+"""retry-annotation fixture (replay scope, PR 16): every swallowed
+disk-IO error on the spill path is observable — counted, attributed
+via log.error, or explicitly waived as lossy."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class SpillStore:
+    def __init__(self):
+        self.io_errors = 0
+
+    def append_counted(self, fh, payload):
+        try:
+            fh.write(payload)
+            fh.flush()
+        except OSError:
+            self.io_errors += 1
+
+    def read_attributed(self, fh, offset, length):
+        try:
+            fh.seek(offset)
+            return fh.read(length)
+        except OSError as err:
+            log.error("spill read failed at %d: %s", offset, err)
+            return None
+
+    def close(self, fh):
+        try:
+            fh.close()
+        except OSError:  # apexlint: lossy(handle close at shutdown)
+            pass

@@ -101,6 +101,21 @@ if ! python bench.py --tiered-ab --smoke --perf-gate; then
     failed_files+=("bench.py --tiered-ab --smoke")
 fi
 
+# Disk-arm smoke (replay/disk_store.py, PR 16): the same swap loop
+# with admission-door losers spilling to the async disk writeback vs
+# spill off, plus the retention soak (disk holds 8x the cold tier's
+# capacity) and promote() readback. Hard criteria: retention >= 8x,
+# zero io_errors/corrupt segments; --perf-gate anti-ratchets the
+# on-arm grad-steps/s against the last comparable (same storage/ring/
+# cold capacity/smoke class) TIERED_DISK_SMOKE.json; failing runs
+# never reseed the baseline.
+echo
+echo "=== bench.py --tiered-ab --tiered-disk --smoke"
+if ! python bench.py --tiered-ab --tiered-disk --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --tiered-ab --tiered-disk --smoke")
+fi
+
 # Serving-tier smoke: the multi-tenant A/B + 2x-overload shedding
 # phase (parallel/inference_server.py serving tier). The lane's own
 # criteria are hard (multi/single >= 0.9 both orders pooled, top-class

@@ -306,10 +306,27 @@ def test_retry_annotation_fixtures():
     assert "OSError" in f.message and "lossy" in f.message
 
 
-def test_retry_annotation_scope_is_comm_and_runtime(tmp_path):
-    # the same silent swallow OUTSIDE comm/ or runtime/ is not flagged:
-    # the rule is about the transport/runtime loss contract, not a
-    # repo-wide style ban
+def test_retry_annotation_replay_fixtures():
+    # PR 16 extends the checker's scope to replay/ — the disk spill
+    # rung does real file IO and a swallowed OSError there is a
+    # silently lost segment
+    good = retry_annotation.check_paths(
+        [_fx(os.path.join("replay", "diskio_good.py"))])
+    assert good.findings == []
+    assert good.waivers == 1  # the justified shutdown-close waiver
+
+    bad = retry_annotation.check_paths(
+        [_fx(os.path.join("replay", "diskio_bad.py"))])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "retry-annotation"
+    assert "OSError" in f.message and "lossy" in f.message
+
+
+def test_retry_annotation_scope_is_comm_runtime_replay(tmp_path):
+    # the same silent swallow OUTSIDE comm/, runtime/, or replay/ is
+    # not flagged: the rule is about the transport/runtime/spill loss
+    # contract, not a repo-wide style ban
     bad_src = open(
         _fx(os.path.join("comm", "retry_bad.py")), encoding="utf-8").read()
     elsewhere = tmp_path / "elsewhere.py"

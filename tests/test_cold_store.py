@@ -292,3 +292,146 @@ def test_replay_config_cold_defaults_off():
     cfg = ReplayConfig()
     assert cfg.cold_tier_capacity == 0
     assert dataclasses.replace(cfg).cold_tier_capacity == 0
+
+
+# -- disk-spill hook (PR 16: ColdStore -> replay/disk_store.py) ------------
+
+
+class _FakeSpill:
+    """Records offers; configurable accept so queue-full refusal paths
+    are testable without a real writeback thread."""
+
+    def __init__(self, accept=True):
+        self.offers = []
+        self.accept = accept
+
+    def offer(self, seg):
+        self.offers.append(seg)
+        return self.accept
+
+
+def _spill_store(cap=16, accept=True):
+    spec = {"x": jax.ShapeDtypeStruct((4, 1024), np.uint8)}
+    spill = _FakeSpill(accept)
+    cs = ColdStore(spec, capacity_transitions=cap, unit_items=4,
+                   ptail=(4,), spill=spill)
+    return cs, spill
+
+
+def _fill(cs, rng, masses):
+    for mass in masses:
+        items, pri = _tiny_seg(rng, mass)
+        assert cs.put(items, pri, live=4) == "stored"
+
+
+def test_cold_spill_door_dropped_candidate_is_offered():
+    rng = np.random.default_rng(6)
+    cs, spill = _spill_store(cap=16)
+    _fill(cs, rng, (0.4, 0.5, 0.6, 0.7))
+    items, pri = _tiny_seg(rng, 0.1)  # lighter than everything stored
+    assert cs.put(items, pri, live=4) == "dropped"
+    assert cs.dropped == 1 and cs.spilled == 1
+    [seg] = spill.offers
+    assert seg.mass_sum == pytest.approx(0.1 * 4)
+    assert seg.live == 4 and len(seg.payload) > 0
+
+
+def test_cold_spill_displacement_victims_are_offered():
+    rng = np.random.default_rng(7)
+    cs, spill = _spill_store(cap=16)
+    _fill(cs, rng, (0.2, 0.5, 0.6, 0.7))
+    items, pri = _tiny_seg(rng, 0.9)  # displaces the 0.2 segment
+    assert cs.put(items, pri, live=4) == "stored"
+    assert cs.displaced == 1 and cs.spilled == 1
+    [victim] = spill.offers
+    assert victim.mass_sum == pytest.approx(0.2 * 4)
+
+
+def test_cold_spill_refusal_not_counted_as_spilled():
+    rng = np.random.default_rng(8)
+    cs, spill = _spill_store(cap=16, accept=False)
+    _fill(cs, rng, (0.4, 0.5, 0.6, 0.7))
+    items, pri = _tiny_seg(rng, 0.1)
+    assert cs.put(items, pri, live=4) == "dropped"
+    assert len(spill.offers) == 1  # offered, refused (queue full)
+    assert cs.spilled == 0
+
+
+def test_cold_spill_all_dead_regions_never_offered():
+    rng = np.random.default_rng(9)
+    cs, spill = _spill_store(cap=16)
+    items, pri = _tiny_seg(rng, 0.0)
+    assert cs.put(items, pri, live=0) == "dropped"
+    assert spill.offers == []  # zero mass: nothing worth disk bytes
+
+
+def test_put_segment_door_without_touching_eviction_counters():
+    from ape_x_dqn_tpu.replay.cold_store import ColdSegment
+    rng = np.random.default_rng(10)
+    cs, spill = _spill_store(cap=16)
+    _fill(cs, rng, (0.3, 0.5, 0.6, 0.7))
+    stored0, dropped0 = cs.stored, cs.dropped
+    # a promoted segment heavier than the lightest resident: admitted,
+    # victim spills back down, stored/dropped stay untouched (the
+    # driver closure is denominated in ring evictions, not promotions)
+    heavy = ColdSegment(b"promoted-bytes", 1, 4, 48, 0.4 * 4, 0.4, 7)
+    assert cs.put_segment(heavy) == "stored"
+    assert cs.displaced == 1
+    [victim] = spill.offers
+    assert victim.mass_sum == pytest.approx(0.3 * 4)
+    # a promoted segment lighter than the floor: dropped, NOT
+    # re-spilled (ping-pong prevention)
+    light = ColdSegment(b"light-bytes", 1, 4, 48, 0.01, 0.01, 8)
+    assert cs.put_segment(light) == "dropped"
+    assert len(spill.offers) == 1
+    assert (cs.stored, cs.dropped) == (stored0, dropped0)
+
+
+def test_displacement_floor_tracks_lightest_at_capacity():
+    rng = np.random.default_rng(11)
+    cs, _ = _spill_store(cap=16)
+    assert cs.displacement_floor() == 0.0
+    _fill(cs, rng, (0.4, 0.6))
+    assert cs.displacement_floor() == 0.0  # below capacity
+    _fill(cs, rng, (0.5, 0.7))
+    assert cs.displacement_floor() == pytest.approx(0.4 * 4)
+
+
+# -- ReplayConfig disk-knob validation (PR 16) -----------------------------
+
+
+def test_replay_config_rejects_negative_disk_capacity():
+    with pytest.raises(ValueError, match="cold_tier_disk_capacity"):
+        ReplayConfig(cold_tier_disk_capacity=-1)
+
+
+def test_replay_config_disk_requires_ram_tier():
+    with pytest.raises(ValueError, match="cold_tier_capacity > 0"):
+        ReplayConfig(cold_tier_disk_capacity=1 << 20)
+
+
+def test_replay_config_disk_requires_dir():
+    with pytest.raises(ValueError, match="cold_tier_disk_dir"):
+        ReplayConfig(cold_tier_capacity=1 << 16,
+                     cold_tier_disk_capacity=1 << 20)
+
+
+def test_replay_config_disk_knob_bounds():
+    kw = dict(cold_tier_capacity=1 << 16,
+              cold_tier_disk_capacity=1 << 20,
+              cold_tier_disk_dir="/tmp/x")
+    assert ReplayConfig(**kw).cold_tier_disk_queue == 16
+    with pytest.raises(ValueError, match="cold_tier_disk_queue"):
+        ReplayConfig(**kw, cold_tier_disk_queue=0)
+    with pytest.raises(ValueError, match="cold_tier_disk_file_bytes"):
+        ReplayConfig(**kw, cold_tier_disk_file_bytes=100)
+    with pytest.raises(ValueError, match="cold_tier_disk_compact_frac"):
+        ReplayConfig(**kw, cold_tier_disk_compact_frac=1.5)
+    with pytest.raises(ValueError, match="cold_tier_disk_promote"):
+        ReplayConfig(**kw, cold_tier_disk_promote=-1)
+
+
+def test_replay_config_disk_defaults_off():
+    cfg = ReplayConfig()
+    assert cfg.cold_tier_disk_capacity == 0
+    assert dataclasses.replace(cfg).cold_tier_disk_capacity == 0

@@ -487,3 +487,120 @@ def test_stager_discard_tail():
     assert st.tail_units() == 3
     st.discard_tail()
     assert st.tail_units() == 0 and shipped == []
+
+
+# -- per-shard cold-door closure + the disk rung (PR 16) -------------------
+# The dist eviction swap runs per dp shard, so the closure holds PER
+# SHARD: evicted[d] == stored[d] + dropped[d], sums matching the
+# scalar counters exactly. The disk rung hangs off the RAM door and
+# never perturbs that closure (spills/promotions are side traffic).
+
+
+def test_cold_tier_dp2_per_shard_closure():
+    d = ApexDriver(_dp2(_cold_ring_cfg()))
+    assert d.is_dist and d.dp == 2 and d._cold is not None
+    block = _fill_ring(d)
+    for i in range(4):
+        d._ingest_one(_synth_batch(d, block, seed=60 + i), block)
+    d._stager.drain()
+    assert d._cold_evicted > 0
+    per_ev = d._cold_evicted_per_shard
+    assert per_ev.shape == (2,) and (per_ev > 0).all()
+    np.testing.assert_array_equal(
+        per_ev, d._cold_stored_per_shard + d._cold_dropped_per_shard)
+    assert int(per_ev.sum()) == d._cold_evicted
+    assert int(d._cold_stored_per_shard.sum()) == d._cold_stored
+    assert int(d._cold_dropped_per_shard.sum()) == d._cold_dropped
+    assert d._cold_evicted == d._cold_stored + d._cold_dropped
+    assert d._replay_filled == d.capacity
+    # per-shard ring sizes stay full through the swap churn
+    sizes = np.asarray(d.state.replay.size)
+    assert sizes.shape == (2,)
+    assert (sizes == d.capacity // d.dp).all()
+
+
+def test_cold_tier_dp2_recall_keeps_per_shard_closure():
+    d = ApexDriver(_dp2(_cold_ring_cfg()))
+    block = _fill_ring(d)
+    for i in range(4):
+        d._ingest_one(_synth_batch(d, block, seed=70 + i), block)
+    d._stager.drain()
+    assert len(d._cold) > 0
+    d._cold_refill_tick()
+    d._stager.drain()
+    assert d._cold_recalled > 0
+    np.testing.assert_array_equal(
+        d._cold_evicted_per_shard,
+        d._cold_stored_per_shard + d._cold_dropped_per_shard)
+    assert int(d._cold_evicted_per_shard.sum()) == d._cold_evicted
+
+
+def _disk_cfg(tmp_path, **replay_kw):
+    kw = dict(cold_tier_capacity=32,  # ~3 eviction blocks' worth of
+              # live transitions: later puts displace or drop -> spills
+              cold_tier_disk_capacity=1 << 16,
+              cold_tier_disk_dir=str(tmp_path / "spill"))
+    kw.update(replay_kw)
+    return _cold_ring_cfg(**kw)
+
+
+def test_cold_disk_captures_door_losers(tmp_path):
+    d = ApexDriver(_disk_cfg(tmp_path))
+    assert d._disk is not None
+    block = _fill_ring(d)
+    for i in range(8):
+        d._ingest_one(_synth_batch(d, block, seed=90 + i), block)
+    d._stager.drain()
+    d._disk.drain(timeout=10.0)
+    s = d._disk.stats()
+    assert d._cold.spilled > 0
+    assert s["spilled"] == d._cold.spilled  # queue never refused here
+    assert s["transitions"] > 0 and s["io_errors"] == 0
+    # the eviction closure is untouched by spill traffic
+    assert d._cold_evicted == d._cold_stored + d._cold_dropped
+    assert d._cold.transitions <= d.cfg.replay.cold_tier_capacity
+    d._disk.close()
+
+
+def test_cold_disk_refill_tick_promotes(tmp_path):
+    d = ApexDriver(_disk_cfg(tmp_path))
+    block = _fill_ring(d)
+    for i in range(8):
+        d._ingest_one(_synth_batch(d, block, seed=110 + i), block)
+    d._stager.drain()
+    d._disk.drain(timeout=10.0)
+    assert d._disk.stats()["segments"] > 0
+    # the idle tick recalls RAM segments first (making door room), then
+    # promotes the heaviest disk segment back through put_segment
+    d._cold_refill_tick()
+    d._stager.drain()
+    assert d._disk.stats()["promoted"] >= 1
+    assert d._cold_evicted == d._cold_stored + d._cold_dropped
+    d._disk.close()
+
+
+def test_cold_disk_dp2_per_shard_closure(tmp_path):
+    d = ApexDriver(_dp2(_disk_cfg(tmp_path)))
+    assert d.is_dist and d._disk is not None
+    block = _fill_ring(d)
+    for i in range(6):
+        d._ingest_one(_synth_batch(d, block, seed=130 + i), block)
+    d._stager.drain()
+    d._disk.drain(timeout=10.0)
+    assert d._cold.spilled > 0
+    np.testing.assert_array_equal(
+        d._cold_evicted_per_shard,
+        d._cold_stored_per_shard + d._cold_dropped_per_shard)
+    assert int(d._cold_evicted_per_shard.sum()) == d._cold_evicted
+    d._disk.close()
+
+
+def test_cold_disk_stats_reach_run_report_shape(tmp_path):
+    """The disk block in the driver's run() output mirrors
+    DiskStore.stats() — pin the keys the bench and obs read."""
+    d = ApexDriver(_disk_cfg(tmp_path))
+    s = d._disk.stats()
+    assert set(s) >= {"segments", "transitions", "bytes", "files",
+                      "spilled", "promoted", "dropped", "queue_full",
+                      "io_errors", "corrupt_segments", "compactions"}
+    d._disk.close()

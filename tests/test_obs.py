@@ -295,6 +295,39 @@ def test_report_multichip_section():
     assert "below healthy" in text  # dp=2 efficiency warn fires
 
 
+def test_report_cold_tier_section_and_thrash_check():
+    """The tiered-replay section renders door + disk-rung lines from
+    the cold_* instruments, and the bespoke check_violations row fires
+    when door drops outrun displacements AND the disk rung did not
+    absorb them — but stays quiet once spills keep pace (PR 16)."""
+    from ape_x_dqn_tpu.obs.report import check_violations
+    rec = {"step": 0,
+           "gauge/cold_segments": 12.0, "gauge/cold_bytes": 4096.0,
+           "gauge/cold_compression_ratio": 3.1,
+           "gauge/cold_disk_segments": 16.0,
+           "gauge/cold_disk_transitions": 2048.0,
+           "gauge/cold_disk_bytes": 65536.0,
+           "ctr/cold_evictions": 100.0, "ctr/cold_recalls": 5.0,
+           "ctr/cold_displaced": 10.0, "ctr/cold_dropped": 40.0,
+           "ctr/cold_disk_spills": 3.0,
+           "ctr/cold_disk_promotions": 2.0,
+           "ctr/cold_disk_queue_full": 1.0}
+    s = summarize([rec])
+    text = format_report(s)
+    assert "tiered replay" in text
+    assert "disk rung" in text
+    assert "spills=3" in text
+    assert "door drops outrun displacements" in text  # ⚠ warn line
+    viols = check_violations(s)
+    assert any("cold_dropped" in v and "thrashing" in v for v in viols)
+    # disk rung absorbing the overflow (spills >= drops) clears both
+    # the section warning and the check violation
+    rec["ctr/cold_disk_spills"] = 64.0
+    s2 = summarize([rec])
+    assert "door drops outrun" not in format_report(s2)
+    assert not any("cold_dropped" in v for v in check_violations(s2))
+
+
 def test_report_cli_subprocess(tmp_path):
     import os
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -2,8 +2,12 @@
 
 The elastic-fleet contract (PR 7) is that EVERY dropped send, failed
 pull, or torn connection surfaces somewhere an operator can see —
-never a bare `except OSError: pass`. In `comm/` and `runtime/`
-modules, any except handler typed on a socket-ish error class
+never a bare `except OSError: pass`. PR 16 extends the same contract
+to `replay/`: the disk spill rung does real file IO off the ingest
+thread, and a swallowed OSError there is a silently lost replay
+segment — exactly the loss class this checker exists to surface. In
+`comm/`, `runtime/`, and `replay/` modules, any except handler typed
+on a socket-ish/IO error class
 (OSError, ConnectionError and its subclasses, socket.error,
 socket.timeout, TimeoutError, BrokenPipeError, InterruptedError) that
 *swallows* the exception (no `raise` anywhere in the handler body)
@@ -33,8 +37,10 @@ from tools.apexlint.common import CheckResult, Finding, ModuleSource
 CHECKER = "retry-annotation"
 
 # paths under these package segments are in scope: the transport and
-# the runtime are where a swallowed socket error means silent data loss
-SCOPE_SEGMENTS = ("/comm/", "/runtime/")
+# the runtime are where a swallowed socket error means silent data
+# loss, and the replay tier (disk spill rung, PR 16) is where a
+# swallowed file-IO error means a silently lost segment
+SCOPE_SEGMENTS = ("/comm/", "/runtime/", "/replay/")
 
 SOCKET_ERROR_NAMES = {
     "OSError", "IOError", "ConnectionError", "ConnectionResetError",
