@@ -472,6 +472,15 @@ class ObsConfig:
     # jax.devices()[0].device_kind (obs/profiling.device_peaks)
     device_peak_flops: float = 0.0
     device_peak_bytes_per_s: float = 0.0
+    # -- forensics plane (obs/blackbox.py, ISSUE 17) --------------------
+    # per-process flight recorder: fixed-size ring of attributed
+    # events, dumped to blackbox-<peer>.json on crash / StallError /
+    # SIGUSR2 / supervisor request. blackbox_dir="" puts dumps next to
+    # the run JSONL (cwd when metrics are in-memory).
+    blackbox: bool = True
+    blackbox_dir: str = ""
+    blackbox_capacity: int = 512
+    blackbox_log_lines: int = 64
 
 
 @dataclass(frozen=True)

@@ -23,10 +23,13 @@ First-class Ape-X health instruments (ISSUE 2 / Horgan et al. 2018 §4):
 
 from __future__ import annotations
 
+import os
+import tempfile
 from typing import Any
 
 import numpy as np
 
+from ape_x_dqn_tpu.obs.blackbox import NULL_BLACKBOX, FlightRecorder
 from ape_x_dqn_tpu.obs.health import (
     HeartbeatRegistry, HeartbeatWatchdog, StallError)
 from ape_x_dqn_tpu.obs.registry import MetricRegistry, geometric_edges
@@ -55,6 +58,7 @@ class NullObs:
     profiler = None
     perf = None
     learn = None
+    blackbox = NULL_BLACKBOX
 
     def span(self, name: str, **args: Any):
         return NULL_TRACER.span(name)
@@ -231,6 +235,43 @@ class Obs:
             min_samples=getattr(cfg, "learn_min_samples", 8),
             cooldown_s=getattr(cfg, "learn_cooldown_s", 30.0))
             if getattr(cfg, "learn_health", True) else None)
+        # forensics plane (obs/blackbox.py, ISSUE 17): per-process
+        # flight recorder, dumped on crash/stall/SIGUSR2/supervisor
+        # request. Default dump dir rides next to the run JSONL;
+        # in-memory-metrics runs (tests, embedded probes) fall back to
+        # the system temp dir, never the CWD
+        if getattr(cfg, "blackbox", True):
+            bb_dir = (getattr(cfg, "blackbox_dir", "")
+                      or os.path.dirname(
+                          getattr(getattr(metrics, "_fh", None),
+                                  "name", "") or "")
+                      or tempfile.gettempdir())
+            self.blackbox = FlightRecorder(
+                self, out_dir=bb_dir,
+                capacity=getattr(cfg, "blackbox_capacity", 512),
+                log_lines=getattr(cfg, "blackbox_log_lines", 64))
+            # attributed degradation events flow into the ring so the
+            # box tells the story leading up to the dump
+            if self.perf is not None:
+                self.perf.add_listener(self._blackbox_perf_event)
+            if self.learn is not None:
+                self.learn.add_listener(self._blackbox_learn_event)
+        else:
+            self.blackbox = NULL_BLACKBOX
+
+    def _blackbox_perf_event(self, name, value, baseline, step,
+                             peer) -> None:
+        self.blackbox.record("perf_degradation", component=name,
+                             peer=peer, value=round(float(value), 4),
+                             baseline=round(float(baseline), 4),
+                             step=int(step))
+
+    def _blackbox_learn_event(self, rule, value, baseline, step,
+                              tenant) -> None:
+        self.blackbox.record("learning_degradation", component=rule,
+                             tenant=tenant, value=round(float(value), 4),
+                             baseline=round(float(baseline), 4),
+                             step=int(step))
 
     # -- tracing -----------------------------------------------------------
 
@@ -265,6 +306,13 @@ class Obs:
                                  stall_component=e.component,
                                  stall_staleness_s=e.staleness_s,
                                  stall_note=e.last_note)
+                # archive the box BEFORE closing: the StallError is a
+                # terminal event and the ring is its evidence
+                self.blackbox.record("stall", component=e.component,
+                                     staleness_s=round(e.staleness_s, 1),
+                                     note=e.last_note)
+                self.blackbox.dump("stall", component=e.component,
+                                   step=self._learner_step)
                 # flush the trace + final snapshot NOW: the artifacts
                 # matter most on the crash path, and not every caller
                 # wraps its loop in try/finally
@@ -392,6 +440,9 @@ class Obs:
         JSONL record (`span/<name>` dicts carry the stage-time
         breakdown obs/report.py prints)."""
         self.set_learner_step(step)
+        # cheap periodic anchor: every dump's ring shows when the last
+        # healthy publish happened, whatever else it recorded
+        self.blackbox.record("publish", step=int(step))
         if self._compile_telemetry is not None:
             self._compile_telemetry.publish_into(self)
         agg = self.tracer.aggregates()
@@ -409,6 +460,8 @@ class Obs:
             self._prof_state = None
         self.publish(step)
         self.tracer.close()
+        # crash hooks must not outlive the session that owns the ring
+        self.blackbox.uninstall()
 
 
 def build_obs(obs_cfg, metrics) -> Obs | NullObs:

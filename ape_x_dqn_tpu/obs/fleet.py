@@ -226,6 +226,12 @@ class FleetAggregator:
             if seq <= st["seq"]:
                 return  # duplicate/reordered frame: keep state monotonic
             st["seq"] = seq
+            # forensics retention (obs/postmortem.py): the LAST frame
+            # from each peer is kept even after disconnect — for a peer
+            # that dies without dumping its own black box, this is the
+            # black box of last resort
+            st["frame"] = frame
+            st["recv_unix"] = time.time()
             if rows_out is not None and st["rows_out"] is not None \
                     and now > st["t"]:
                 st["rate"] = (max(int(rows_out) - st["rows_out"], 0)
@@ -301,7 +307,21 @@ class FleetAggregator:
                               if p["connected"])
         obs.count("peer_disconnects")
         obs.gauge("fleet_peers", n_connected)
+        bb = getattr(obs, "blackbox", None)
+        if bb is not None:
+            bb.record("peer_disconnect", peer=peer)
         self._metrics.log(self._step(), peer_disconnect=peer)
+
+    def retained_frames(self) -> dict[str, dict]:
+        """Last telemetry frame per peer (connected or not), for the
+        postmortem bundler: ``{peer: {frame, recv_unix, connected}}``.
+        Peers that never completed a frame are omitted."""
+        with self._lock:
+            return {peer: {"frame": st["frame"],
+                           "recv_unix": st["recv_unix"],
+                           "connected": bool(st["connected"])}
+                    for peer, st in self._peers.items()
+                    if st.get("frame") is not None}
 
     def on_decode_error(self, peer: str, reason: str) -> None:
         """A truncated/garbled frame arrived (and dropped its

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Any
 
@@ -256,6 +257,17 @@ INSTRUMENTS = {
                  "faults are firing faster than "
                  "remediation.budget_per_min allows responses")},
     "remediation_mode": {"kind": "gauge"},
+    # forensics plane (obs/blackbox.py + obs/postmortem.py, ISSUE 17):
+    # flight-recorder activity counters. Healthy ranges are bespoke
+    # rows in check_violations (ctr warns don't fit the single-value
+    # rule shapes): a terminal stall/quarantine with no dump on disk
+    # fails the check naming the missing peer, and a ring-drop
+    # fraction above 1/2 (blackbox_dropped vs blackbox_records) warns
+    # that most of the forensic window was overwritten before any dump.
+    "blackbox_records": {"kind": "ctr"},
+    "blackbox_dumps": {"kind": "ctr"},
+    "blackbox_dropped": {"kind": "ctr"},
+    "postmortem_bundles": {"kind": "ctr"},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -288,6 +300,9 @@ def summarize(records: list[dict]) -> dict[str, Any]:
     perf_events: list[dict] = []
     learn_events: list[dict] = []
     remediation_events: list[dict] = []
+    quarantines: list[dict] = []
+    peer_stalls: list[dict] = []
+    blackbox_dumps: list[dict] = []
     for rec in records:
         for k, v in rec.items():
             if v is not None:
@@ -306,6 +321,28 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         if rec.get("peer_disconnect") is not None:
             disconnects.append({"step": rec.get("step"),
                                 "peer": rec["peer_disconnect"]})
+        if rec.get("actor_quarantined") is not None:
+            quarantines.append({"step": rec.get("step"),
+                                "component":
+                                    f"actor-{rec['actor_quarantined']}",
+                                "staleness_s":
+                                    rec.get("stall_staleness_s")})
+        if rec.get("peer_stall") is not None:
+            peer_stalls.append({"step": rec.get("step"),
+                                "component": rec["peer_stall"],
+                                "staleness_s":
+                                    rec.get("stall_staleness_s")})
+        if rec.get("blackbox_dump") is not None:
+            blackbox_dumps.append({"step": rec.get("step"),
+                                   "path": rec["blackbox_dump"],
+                                   "reason": rec.get("blackbox_reason"),
+                                   "peer": rec.get("blackbox_peer"),
+                                   "component":
+                                       rec.get("blackbox_component"),
+                                   "recorded":
+                                       rec.get("blackbox_ring_recorded"),
+                                   "dropped":
+                                       rec.get("blackbox_ring_dropped")})
         if rec.get("perf_degradation") is not None:
             perf_events.append({"step": rec.get("step"),
                                 "name": rec["perf_degradation"],
@@ -410,6 +447,9 @@ def summarize(records: list[dict]) -> dict[str, Any]:
         "perf_events": perf_events,
         "learn_events": learn_events,
         "remediation_events": remediation_events,
+        "quarantines": quarantines,
+        "peer_stalls": peer_stalls,
+        "blackbox_dumps": blackbox_dumps,
     }
 
 
@@ -1020,6 +1060,16 @@ def format_report(summary: dict[str, Any]) -> str:
                 f"silent={_n(s['staleness_s'])}s note={s['note']!r}")
     else:
         lines.append("stall events: none")
+    dumps = summary.get("blackbox_dumps", [])
+    if dumps:
+        lines.append(f"black-box dumps: {len(dumps)} "
+                     "(obs/blackbox.py; bundle with obs/postmortem.py)")
+        for d in dumps[-5:]:
+            lines.append(
+                f"  step={_n(d['step'])} reason={d.get('reason')} "
+                f"peer={d.get('peer')} "
+                f"component={d.get('component') or '-'} "
+                f"path={d.get('path')}")
     return "\n".join(lines)
 
 
@@ -1091,7 +1141,160 @@ def check_violations(summary: dict[str, Any]) -> list[str]:
             f"spills ({_n(spills)}) did not absorb them: the cold "
             f"store is thrashing; grow cold_tier_capacity or enable "
             f"the disk rung (cold_tier_disk_capacity)")
+    # forensics (ISSUE 17): evidence must survive the event it
+    # documents. A terminal StallError / quarantine whose run left no
+    # black-box dump on disk is silent loss of evidence — the same gap
+    # the PR 16 thrash row closed for silent spill lag.
+    terminals = (
+        [("stall", s.get("component")) for s in summary.get("stalls", [])]
+        + [("quarantine", q.get("component"))
+           for q in summary.get("quarantines", [])]
+        + [("peer_stall", p.get("component"))
+           for p in summary.get("peer_stalls", [])])
+    if terminals:
+        on_disk = [d for d in summary.get("blackbox_dumps", [])
+                   if d.get("path") and os.path.exists(str(d["path"]))]
+        if not on_disk:
+            names = ", ".join(sorted({f"{k}:{c}" for k, c in terminals}))
+            out.append(
+                f"blackbox_dumps: {len(terminals)} terminal event(s) "
+                f"({names}) but no black-box dump on disk — silent "
+                f"loss of evidence; the flight recorder "
+                f"(obs/blackbox.py, ObsConfig.blackbox) should have "
+                f"archived the victim's ring as blackbox-<peer>.json")
+    # ring-drop fraction, per dump: overwriting old records is the
+    # ring's normal steady state, so the global ctr ratio is NOT a
+    # health signal — what matters is whether a dump that was supposed
+    # to explain an incident had already lost most of its window
+    for d in summary.get("blackbox_dumps", []):
+        rec_n = float(d.get("recorded") or 0.0)
+        drop_n = float(d.get("dropped") or 0.0)
+        if rec_n > 0 and drop_n > 0.5 * rec_n:
+            out.append(
+                f"blackbox_dropped: dump {d.get('path')} "
+                f"(reason={d.get('reason')}) overwrote {_n(drop_n)} of "
+                f"{_n(rec_n)} ring records before dumping — more than "
+                f"half its forensic window was lost; grow "
+                f"ObsConfig.blackbox_capacity")
     return out
+
+
+# -- postmortem mode (obs/postmortem.py bundles, ISSUE 17) ---------------
+
+# kinds that end a process/component's story — the root-cause walk
+# starts from the LAST of these on the merged timeline
+TERMINAL_KINDS = ("crash", "stall", "quarantine", "peer_stall",
+                  "supervisor_restart", "actor_error", "kill")
+# kinds that count as attributed anomalies when walking backwards
+# (terminal kinds included: an earlier kill can be the cause of a
+# later restart)
+ANOMALY_KINDS = TERMINAL_KINDS + (
+    "wedge", "perf_degradation", "learning_degradation", "remediation",
+    "peer_disconnect", "wire_decode_error", "reconnect", "drop",
+    "backpressure", "serve_error", "instrument_range")
+
+
+def _instrument_anomalies(bundle: dict) -> list[dict]:
+    """Each dump's instrument snapshot run through the INSTRUMENTS
+    healthy-range table (the same predicate as --check): a violated
+    row becomes an attributed anomaly at the dump's wall time."""
+    out = []
+    for d in bundle.get("dumps", []):
+        pseudo = {"gauges": d.get("gauge", {}) or {},
+                  "hists": d.get("hist", {}) or {},
+                  "ctrs": d.get("ctr", {}) or {}}
+        for v in check_violations(pseudo):
+            out.append({"t": float(d.get("wall_unix", 0.0)),
+                        "peer": d.get("peer", "?"),
+                        "kind": "instrument_range",
+                        "component": v.split(":", 1)[0],
+                        "detail": {"violation": v}})
+    return out
+
+
+def postmortem_root_cause(bundle: dict) -> dict | None:
+    """Walk the merged timeline backwards from the terminal event and
+    name the first attributed anomaly preceding it. Returns
+    ``{"terminal", "anomaly", "gap_s"}`` (anomaly None when the
+    terminal event is the first recorded thing), or None for an empty
+    bundle."""
+    timeline = sorted(list(bundle.get("timeline", []))
+                      + _instrument_anomalies(bundle),
+                      key=lambda e: float(e.get("t", 0.0)))
+    if not timeline:
+        return None
+    terminal = None
+    for e in reversed(timeline):
+        if e.get("kind") in TERMINAL_KINDS:
+            terminal = e
+            break
+    if terminal is None:
+        terminal = timeline[-1]
+    t_key = (terminal.get("kind"), terminal.get("peer"),
+             terminal.get("component"))
+    anomaly = None
+    for e in reversed(timeline):
+        if float(e.get("t", 0.0)) > float(terminal.get("t", 0.0)):
+            continue
+        if e is terminal or e.get("kind") not in ANOMALY_KINDS:
+            continue
+        # the same incident often appears twice (ring record + JSONL
+        # event): an echo of the terminal itself is not its cause
+        if (e.get("kind"), e.get("peer"),
+                e.get("component")) == t_key:
+            continue
+        anomaly = e
+        break
+    gap = (float(terminal.get("t", 0.0)) - float(anomaly.get("t", 0.0))
+           if anomaly is not None else None)
+    return {"terminal": terminal, "anomaly": anomaly, "gap_s": gap}
+
+
+def _fmt_event(e: dict) -> str:
+    comp = e.get("component")
+    return (f"{e.get('kind')} peer={e.get('peer')}"
+            + (f" component={comp}" if comp else ""))
+
+
+def format_postmortem(bundle: dict, tail: int = 20) -> str:
+    """Human postmortem: bundle inventory, the timeline tail, and the
+    root-cause line the chaos lane asserts on."""
+    lines = ["postmortem bundle:"]
+    lines.append(f"  peers: {', '.join(bundle.get('peers', [])) or '-'}")
+    lines.append(f"  dumps: {len(bundle.get('dumps', []))}")
+    for s in bundle.get("skipped_dumps", []):
+        lines.append(f"  skipped dump: {s.get('file')} "
+                     f"({s.get('reason')})")
+    lines.append(f"  frames retained: {len(bundle.get('frames', {}))}")
+    lines.append(f"  jsonl tail: {len(bundle.get('jsonl_tail', []))} "
+                 "records")
+    timeline = bundle.get("timeline", [])
+    rc = postmortem_root_cause(bundle)
+    lines.append("")
+    lines.append(f"timeline (last {min(tail, len(timeline))} of "
+                 f"{len(timeline)} events):")
+    t_end = float(timeline[-1]["t"]) if timeline else 0.0
+    for e in timeline[-tail:]:
+        dt = float(e.get("t", 0.0)) - t_end
+        lines.append(f"  {dt:+9.3f}s  {_fmt_event(e)}")
+    lines.append("")
+    if rc is None:
+        lines.append("root cause: no events in bundle")
+        return "\n".join(lines)
+    term = rc["terminal"]
+    if rc["anomaly"] is None:
+        lines.append(f"root cause: none attributed — terminal event "
+                     f"{_fmt_event(term)} is the first recorded event")
+    else:
+        a = rc["anomaly"]
+        detail = a.get("detail") or {}
+        why = detail.get("violation") or detail.get("error") \
+            or detail.get("reason") or ""
+        lines.append(
+            f"root cause: {_fmt_event(a)} at -{rc['gap_s']:.3f}s "
+            f"before terminal {_fmt_event(term)}"
+            + (f" — {why}" if why else ""))
+    return "\n".join(lines)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1100,10 +1303,17 @@ def main(argv: list[str] | None = None) -> int:
         description="Summarize a run's metrics JSONL: stage times, "
                     "staleness percentiles, throughput, stalls.")
     ap.add_argument("jsonl", help="metrics JSONL file (--metrics-file "
-                                  "of a run with obs enabled)")
+                                  "of a run with obs enabled), or a "
+                                  "postmortem bundle with --postmortem")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object instead "
                          "of the text report")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="treat the positional argument as an "
+                         "obs/postmortem.py bundle: print its merged "
+                         "timeline and the root-cause line (walks "
+                         "backwards from the terminal event to the "
+                         "first attributed anomaly)")
     ap.add_argument("--check", action="store_true",
                     help="health-gate mode: print the report, then "
                          "exit 2 if any healthy-range row is violated "
@@ -1117,6 +1327,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--interval", type=float, default=2.0,
                     help="poll interval for --follow (seconds)")
     args = ap.parse_args(argv)
+    if args.postmortem:
+        try:
+            with open(args.jsonl) as fh:
+                bundle = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read bundle {args.jsonl}: {e}",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            rc = postmortem_root_cause(bundle)
+            print(json.dumps({"root_cause": rc,
+                              "peers": bundle.get("peers", []),
+                              "dumps": len(bundle.get("dumps", [])),
+                              "skipped_dumps":
+                                  bundle.get("skipped_dumps", [])}))
+        else:
+            print(format_postmortem(bundle))
+        return 0
     if not args.follow:
         records = load_records(args.jsonl)
         if not records:

@@ -298,6 +298,11 @@ class BatchedInferenceServer:
             try:
                 self._serve_batch(reqs)
             except Exception as e:  # propagate to callers, keep serving
+                # forensics: the error surfaces in the CALLERS' threads;
+                # the ring keeps the server-side attribution
+                self._obs.blackbox.record(
+                    "serve_error", component="inference-server",
+                    error=repr(e)[:200])
                 for r in reqs:
                     r.result = e
                     r.event.set()
@@ -819,6 +824,11 @@ class MultiPolicyInferenceServer:
 
     def _fire_backpressure(self, engaged: bool) -> None:
         self._obs.gauge("serve_backpressure", 1.0 if engaged else 0.0)
+        # backpressure flips are exactly the "significant recent
+        # events" a post-crash ring should narrate
+        self._obs.blackbox.record("backpressure",
+                                  component="inference-server",
+                                  engaged=bool(engaged))
         cb = self.on_backpressure
         if cb is not None:
             cb(engaged)
@@ -838,6 +848,9 @@ class MultiPolicyInferenceServer:
             try:
                 self._forward(fam, reqs, items)
             except Exception as e:  # propagate to callers, keep serving
+                self._obs.blackbox.record(
+                    "serve_error", component="inference-server",
+                    error=repr(e)[:200])
                 for r in reqs:
                     r.result = e
                     r.event.set()

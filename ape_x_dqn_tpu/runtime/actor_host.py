@@ -103,6 +103,17 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         obs_cfg = dataclasses.replace(
             obs_cfg, trace_path=f"{obs_cfg.trace_path}.{peer}")
     obs = build_obs(obs_cfg, Metrics())
+    # forensics plane: this host's flight recorder dumps under the
+    # peer's name, with the transport's reconnect/drop tallies merged
+    # into every dump (SIGUSR2 install is skipped off the main thread)
+    obs.blackbox.set_peer(peer)
+    obs.blackbox.add_context_provider(
+        lambda: {"transport": {
+            "reconnects": raw_transport.reconnects,
+            "dropped": raw_transport.dropped,
+            "drop_reasons": dict(raw_transport.drop_reasons),
+            "epoch": raw_transport.epoch}})
+    obs.blackbox.install()
     emitter: TelemetryEmitter | None = None
     if obs.enabled:
         transport = StampingTransport(transport, peer)
@@ -270,6 +281,11 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
             frames[slot] = actor.run(per_actor, stop_event)
             obs.clear(f"actor-{idx}")  # finished, not stalled
         except Exception as e:  # noqa: BLE001 - reported to caller
+            # the thread dies quietly from the interpreter's point of
+            # view (no excepthook) — archive the ring ourselves
+            obs.blackbox.record("actor_error", component=f"actor-{idx}",
+                                error=repr(e)[:200])
+            obs.blackbox.dump("actor_error", component=f"actor-{idx}")
             errors.append((idx, e))
 
     threads = [threading.Thread(target=actor_thread, args=(i,),

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -218,6 +219,15 @@ class ApexDriver:
             agg = FleetAggregator(self.obs)
             if agg.install(self.transport):
                 self.fleet = agg
+        # forensics plane (obs/blackbox.py): the driver's flight
+        # recorder dumps on crash/atexit/SIGUSR2, and every dump
+        # carries the fleet's retained per-peer telemetry frames — the
+        # black box of last resort for peers that died without one
+        self.obs.blackbox.set_peer(f"driver-{os.getpid()}")
+        if self.fleet is not None:
+            self.obs.blackbox.add_context_provider(
+                lambda: {"peer_frames": self.fleet.retained_frames()})
+        self.obs.blackbox.install()
         # initial publication so remote actor hosts can bootstrap before
         # the learner's first publish_every boundary (they block on
         # get_params); both sides only read these buffers
@@ -705,6 +715,12 @@ class ApexDriver:
             self.obs.count("actor_quarantines")
             self.metrics.log(self._grad_steps_total, actor_quarantined=i,
                              stall_staleness_s=round(staleness, 1))
+            # archive the victim's ring: a quarantine is a terminal
+            # verdict for the slot, so the evidence goes to disk now
+            self.obs.blackbox.record("quarantine", component=f"actor-{i}",
+                                     staleness_s=round(staleness, 1))
+            self.obs.blackbox.dump("quarantine", component=f"actor-{i}",
+                                   step=self._grad_steps_total)
             logging.getLogger(__name__).warning(
                 "[fleet] actor slot %d exhausted its supervised-restart "
                 "budget (%d) — quarantined; the run continues without it",
@@ -727,6 +743,14 @@ class ApexDriver:
                 (i, f"supervised: stalled {staleness:.1f}s"))
         self.metrics.log(self._grad_steps_total, supervisor_restart=i,
                          stall_staleness_s=round(staleness, 1))
+        # every restart decision archives the ring as it stood when the
+        # slot wedged — the postmortem bundler's per-incident evidence
+        self.obs.blackbox.record("supervisor_restart",
+                                 component=f"actor-{i}",
+                                 staleness_s=round(staleness, 1))
+        self.obs.blackbox.dump("supervisor_restart",
+                               component=f"actor-{i}",
+                               step=self._grad_steps_total)
         # re-arm the heartbeat NOW so the check_stalled() fallthrough in
         # this very tick doesn't still see the slot as stale
         self.obs.beat(f"actor-{i}", "supervised restart")
@@ -752,6 +776,14 @@ class ApexDriver:
         self.metrics.log(self._grad_steps_total, peer_stall=name,
                          stall_staleness_s=round(staleness, 1))
         if first:
+            # the remote died without a local ring: dump OURS, which
+            # carries its last retained telemetry frame (context
+            # provider above) — its black box of last resort
+            self.obs.blackbox.record("peer_stall", peer=name.split("/")[0],
+                                     component=name,
+                                     staleness_s=round(staleness, 1))
+            self.obs.blackbox.dump("peer_stall", component=name,
+                                   step=self._grad_steps_total)
             logging.getLogger(__name__).warning(
                 "[fleet] remote component %r silent for %.1fs — "
                 "quarantined from the stall watchdog (its host owns "

@@ -53,6 +53,9 @@ def train_single_process(cfg: RunConfig, total_env_frames: int | None = None,
     # `obs` is this loop's env observation; the observability facade
     # rides as `obs_` (NULL_OBS when cfg.obs is absent/disabled)
     obs_ = build_obs(getattr(cfg, "obs", None), metrics)
+    # crash hooks (obs/blackbox.py): uninstalled again by obs_.close(),
+    # so a healthy run leaves no dump behind
+    obs_.blackbox.install()
     obs_.register("actor-0")
     obs_.register("learner")
     env = make_env(cfg.env, seed=cfg.seed)

@@ -1096,6 +1096,24 @@ def bench_chaos_ab(args) -> dict:
 
         eng = None
         obs_sink = _ObsSink()
+        # forensics (obs/blackbox.py, ISSUE 17): the remediated arm
+        # gives every sender a flight recorder plus one for the
+        # learner side, so the drill leaves the same evidence a real
+        # fleet would — the lane asserts the postmortem bundle
+        # attributes the injected fault by name
+        recs = rec_learner = None
+        fdir = ""
+        if remediate:
+            import tempfile
+
+            from ape_x_dqn_tpu.obs.blackbox import FlightRecorder
+
+            fdir = tempfile.mkdtemp(prefix="chaos_forensics_")
+            recs = [FlightRecorder(obs_sink, peer=f"chaos-sender-{k}",
+                                   out_dir=fdir)
+                    for k in range(n_clients)]
+            rec_learner = FlightRecorder(obs_sink, peer="chaos-learner",
+                                         out_dir=fdir)
         if remediate:
             def _restart(slot: int, staleness_s: float) -> bool:
                 # the driver's supervised slot respawn, approximated
@@ -1108,6 +1126,14 @@ def bench_chaos_ab(args) -> dict:
                 if wedged:
                     wedge.release()
                 kicked = clients[slot].kick()
+                # every restart decision archives the victim's ring —
+                # the driver's supervisor contract, miniaturized
+                recs[slot].record("supervisor_restart",
+                                  component=f"sender-{slot}",
+                                  staleness_s=round(staleness_s, 3),
+                                  wedged=wedged, kicked=kicked)
+                recs[slot].dump("supervisor_restart",
+                                component=f"sender-{slot}")
                 return wedged or kicked
 
             eng = RemediationEngine(
@@ -1168,6 +1194,14 @@ def bench_chaos_ab(args) -> dict:
             proxy.clean()
             proxy.cut()
             decode_errs_prior["n"] = srv.wire_decode_errors
+            if rec_learner is not None:
+                # the injected faults, recorded as the victims would
+                # record them: the learner sees its own kill coming
+                # (srv.stop is this drill's SIGKILL), the wedged
+                # sender's ring keeps the wedge engage
+                rec_learner.record("kill", component="learner", epoch=1)
+                rec_learner.dump("kill", component="learner")
+                recs[0].record("wedge", component="sender-0")
             srv.stop()
             wedge.engage()  # wedged-not-dead: silent, socket open
             time.sleep(window_s * 0.10)  # the outage
@@ -1205,6 +1239,35 @@ def bench_chaos_ab(args) -> dict:
             out["remediation"] = eng.summary()
             out["remediation_actions"] = obs_sink.ctr.get(
                 "remediation_actions", 0)
+        if recs is not None:
+            # bundle the drill's black boxes and ask the report for
+            # the root cause: the lane's artifact records whether the
+            # attributed component IS one of the injected faults
+            from ape_x_dqn_tpu.obs import postmortem as _pm
+            from ape_x_dqn_tpu.obs import report as _report
+
+            bpath = os.path.join(fdir, "POSTMORTEM.json")
+            bundle = _pm.build_bundle(fdir, out_path=bpath,
+                                      obs=obs_sink)
+            root = _report.postmortem_root_cause(bundle) or {}
+            anom = root.get("anomaly") or {}
+            term = root.get("terminal") or {}
+            injected = ("sender-0", "learner")
+            attributed = (anom.get("component") in injected
+                          or term.get("component") in injected)
+            rc_line = _report.format_postmortem(
+                bundle).splitlines()[-1]
+            out["postmortem"] = {
+                "bundle": bpath,
+                "dumps": len(bundle["dumps"]),
+                "skipped_dumps": bundle["skipped_dumps"],
+                "bundles_counted": obs_sink.ctr.get(
+                    "postmortem_bundles", 0),
+                "root_cause": rc_line,
+                "attributes_fault": bool(attributed),
+            }
+            log(f"chaos forensics: {out['postmortem']['dumps']} dumps "
+                f"-> {bpath}; {rc_line}")
         for c in clients:
             c.close()
         proxy.stop()
@@ -1333,6 +1396,175 @@ def bench_learn_health(args) -> None:
     # exit nonzero only when the RUNS failed to produce the plane; an
     # unhealthy-but-present plane is the report --check gate's call
     raise SystemExit(0 if complete else 1)
+
+
+_BLACKBOX_RATIO_FLOOR = 0.95  # recorder-on / recorder-off grad-steps/s
+
+
+def _blackbox_artifact_path(smoke: bool) -> str:
+    here = os.path.dirname(os.path.abspath(__file__))
+    name = "BLACKBOX_SMOKE.json" if smoke else "BLACKBOX_LATEST.json"
+    return os.path.join(here, name)
+
+
+def _load_blackbox_baseline(smoke: bool, frames: int
+                            ) -> tuple[str | None, dict | None]:
+    """Newest COMPARABLE blackbox artifact: same smoke class and same
+    training-run length. The on/off ratio is workload-relative already,
+    but a different frame budget shifts the JIT-warmup / steady-state
+    mix — a cross-shape gate would fire on a budget change, not a
+    recorder regression."""
+    path = _blackbox_artifact_path(smoke)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    if not (isinstance(doc, dict) and "metric" in doc
+            and "value" in doc):
+        return None, None
+    if doc.get("frames") != frames:
+        log(f"blackbox gate: {os.path.basename(path)} is "
+            f"{doc.get('frames')} frames, this run is {frames} — not "
+            f"comparable, skipped")
+        return None, None
+    return path, doc
+
+
+def bench_blackbox_ab(args) -> None:
+    """Flight-recorder overhead A/B (ISSUE 17): the same short REAL
+    training run through the single-process driver with the obs plane
+    on, once with the FlightRecorder live (crash hooks installed,
+    publish/stall/perf events recorded into the ring) and once with
+    ``ObsConfig.blackbox=False`` (NULL_BLACKBOX). Both orders x
+    `--repeats` so JIT warmup and page-cache drift can't masquerade as
+    recorder cost. The headline is grad-steps/s recorder-on over
+    recorder-off — forensics must ride along for free (>= 0.95 on the
+    full lane). A functional dump round-trip (record -> dump -> parse)
+    rides in the same artifact, because a HEALTHY A/B run never
+    crashes and so never exercises the path the recorder exists for;
+    the lane also asserts the healthy runs left no dump behind (the
+    atexit hook is uninstalled by ``obs.close()``)."""
+    import glob
+    import tempfile
+
+    from ape_x_dqn_tpu.configs import (EnvConfig, LearnerConfig,
+                                       NetworkConfig, ObsConfig,
+                                       ReplayConfig, get_config)
+    from ape_x_dqn_tpu.obs.blackbox import FlightRecorder
+    from ape_x_dqn_tpu.runtime.single_process import train_single_process
+    from ape_x_dqn_tpu.utils.metrics import Metrics
+
+    frames = int(args.bb_frames)
+    repeats = max(int(args.repeats), 1)
+    bb_dir = tempfile.mkdtemp(prefix="blackbox_ab_")
+
+    def one_arm(blackbox_on: bool) -> float:
+        cfg = get_config("pong").replace(
+            env=EnvConfig(id="catch", kind="synthetic_atari"),
+            network=NetworkConfig(kind="nature_cnn", dueling=True,
+                                  compute_dtype="float32"),
+            replay=ReplayConfig(kind="prioritized", capacity=2048,
+                                min_fill=300),
+            learner=LearnerConfig(batch_size=16, n_step=3,
+                                  target_sync_every=16, sample_chunk=2),
+            obs=ObsConfig(enabled=True, publish_every_steps=50,
+                          heartbeat_timeout_s=120.0,
+                          blackbox=blackbox_on, blackbox_dir=bb_dir))
+        metrics = Metrics()  # in-memory: no JSONL I/O in the timed arm
+        t0 = time.monotonic()
+        out = train_single_process(cfg, total_env_frames=frames,
+                                   metrics=metrics, train_every=2)
+        wall = time.monotonic() - t0
+        return out["grad_steps"] / wall if wall > 0 else 0.0
+
+    on_runs: list[float] = []
+    off_runs: list[float] = []
+    for order in ("off_first", "on_first"):
+        arms = (False, True) if order == "off_first" else (True, False)
+        for arm_on in arms:
+            for _ in range(repeats):
+                rate = one_arm(arm_on)
+                (on_runs if arm_on else off_runs).append(rate)
+                log(f"blackbox A/B [{order}] recorder="
+                    f"{'on' if arm_on else 'off'}: {rate:.4g} "
+                    f"grad-steps/s")
+    # healthy runs must leave NO dump: the crash hooks were installed
+    # and then uninstalled by obs.close() before process exit
+    stray = sorted(os.path.basename(p) for p in
+                   glob.glob(os.path.join(bb_dir, "blackbox-*.json")))
+    # functional round-trip: prove the dump path works here rather
+    # than trusting it to the next real crash
+    class _Sink:  # minimal obs facade (the bench has no Obs)
+        def __init__(self):
+            self.ctr: dict[str, int] = {}
+
+        def count(self, name, n=1):
+            self.ctr[name] = self.ctr.get(name, 0) + n
+
+    sink = _Sink()
+    rec = FlightRecorder(sink, peer="bench-bb", out_dir=bb_dir)
+    rec.record("publish", step=1)
+    dump_path = rec.dump("bench_roundtrip", component="bench")
+    dump_ok = False
+    if dump_path:
+        try:
+            with open(dump_path) as fh:
+                doc = json.load(fh)
+            dump_ok = (doc.get("blackbox") == 1
+                       and doc.get("peer") == "bench-bb"
+                       and len(doc.get("records", [])) == 1
+                       and sink.ctr.get("blackbox_dumps", 0) == 1)
+        except (OSError, json.JSONDecodeError):
+            dump_ok = False
+    med_on = spread(on_runs)["median"]
+    med_off = spread(off_runs)["median"]
+    ratio = round(med_on / med_off, 4) if med_off > 0 else 0.0
+    result = {
+        "metric": "blackbox_gradsteps_ratio",
+        "value": ratio,
+        "unit": "frac",
+        "frames": frames,
+        "on_grad_steps_per_s": spread(on_runs),
+        "off_grad_steps_per_s": spread(off_runs),
+        "dump_roundtrip_ok": dump_ok,
+        "healthy_runs_left_no_dump": not stray,
+        "stray_dumps": stray,
+    }
+    log(f"blackbox A/B: recorder-on {spread(on_runs)} vs off "
+        f"{spread(off_runs)} grad-steps/s (ratio {ratio}), dump "
+        f"round-trip {'ok' if dump_ok else 'FAILED'}, stray dumps "
+        f"{stray or 'none'}")
+    line = json.dumps(result)
+    rc = 0
+    if not dump_ok:
+        log("blackbox gate FAIL: dump round-trip did not produce a "
+            "parseable blackbox-<peer>.json")
+        rc = 1
+    if stray:
+        log(f"blackbox gate FAIL: healthy A/B runs left dump(s) "
+            f"behind: {stray}")
+        rc = rc or 1
+    gated = getattr(args, "perf_gate", False)
+    if gated:
+        args._baseline = _load_blackbox_baseline(args.smoke, frames)
+        rc = rc or _gate_exit(result, args)
+    if not args.smoke and ratio < _BLACKBOX_RATIO_FLOOR:
+        log(f"blackbox gate FAIL: on/off ratio {ratio} below the "
+            f"acceptance floor {_BLACKBOX_RATIO_FLOOR}")
+        rc = rc or 1
+    if rc == 0:
+        path = _blackbox_artifact_path(args.smoke)
+        try:
+            with open(path, "w") as fh:
+                fh.write(line + "\n")
+        except OSError as e:
+            log(f"could not write blackbox artifact {path}: {e!r}")
+    else:
+        log("blackbox gate: artifact of record NOT updated by this "
+            "failing run")
+    print(line, flush=True)
+    raise SystemExit(rc)
 
 
 def wire_codec_summary() -> dict:
@@ -3026,6 +3258,20 @@ def main() -> None:
     p.add_argument("--lh-frames", type=int, default=1400,
                    help="env frames per game for the --learn-health "
                    "lane")
+    p.add_argument("--blackbox-ab", action="store_true",
+                   help="run the flight-recorder overhead A/B INSTEAD "
+                   "of the main bench (obs/blackbox.py, ISSUE 17): "
+                   "the same short real training run with the "
+                   "FlightRecorder on vs ObsConfig.blackbox=False, "
+                   "both orders x --repeats, plus a dump round-trip "
+                   "check and a no-stray-dump check on the healthy "
+                   "runs. Writes BLACKBOX_LATEST.json "
+                   "(BLACKBOX_SMOKE.json under --smoke; PERF.md "
+                   "'Flight recorder'); the full lane gates the "
+                   "on/off grad-steps/s ratio at >= 0.95")
+    p.add_argument("--bb-frames", type=int, default=1400,
+                   help="env frames per arm for the --blackbox-ab "
+                   "lane")
     p.add_argument("--ab-batch-size", type=int, default=64,
                    help="batch size for the prefetch A/B arms (small "
                    "enough to iterate on a CPU host; raise on a real "
@@ -3072,6 +3318,7 @@ def main() -> None:
         args.ab_dispatches = min(args.ab_dispatches, 2)
         args.chaos_ab_seconds = min(args.chaos_ab_seconds, 2.0)
         args.lh_frames = min(args.lh_frames, 800)
+        args.bb_frames = min(args.bb_frames, 600)
         args.tiered_block = min(args.tiered_block, 512)
         # serve_vector stays at the full-lane value: in-flight items
         # (tenants x vector = 2 full batches) give both arms the same
@@ -3092,6 +3339,9 @@ def main() -> None:
         return
     if args.learn_health:
         bench_learn_health(args)
+        return
+    if args.blackbox_ab:
+        bench_blackbox_ab(args)
         return
     if args.tiered_ab:
         if args.tiered_disk:
@@ -3161,11 +3411,23 @@ def main() -> None:
             "unit": "ratio",
             "window_s": ab["window_s"],
             "clients": ab["clients"],
+            "postmortem": ab["remediated"].get("postmortem"),
             "secondary": {"chaos_ab": ab},
         }
         line = json.dumps(result)
         gated = getattr(args, "perf_gate", False)
         rc = 0
+        # forensics gate (ISSUE 17), smoke and full alike — the drill
+        # is deterministic about its faults, so the bundle must exist
+        # and its root-cause line must name an injected component
+        pmres = result["postmortem"] or {}
+        if not (pmres.get("dumps", 0) > 0
+                and os.path.exists(str(pmres.get("bundle", "")))
+                and pmres.get("attributes_fault")):
+            log(f"chaos gate FAIL: postmortem bundle missing or its "
+                f"root cause does not attribute the injected fault — "
+                f"{pmres}")
+            rc = 1
         if gated:
             args._baseline = _load_chaos_baseline(
                 args.smoke, ab["window_s"], ab["clients"])

@@ -1,0 +1,12 @@
+"""obs-names fixture: the two ways a forensics PR drifts.
+
+`blackbox_dumps` is emitted as a gauge while the table lists a ctr
+(the report would look under gauge/ and never see a dump happen);
+`blackbox_scratch` has no row at all (a new recorder quantity the
+report silently drops).
+"""
+
+
+def dump(obs):
+    obs.gauge("blackbox_dumps", 1.0)  # kind mismatch
+    obs.count("blackbox_scratch", 1)  # no INSTRUMENTS row, no waiver

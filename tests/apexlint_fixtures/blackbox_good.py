@@ -1,0 +1,22 @@
+"""obs-names fixture: the flight-recorder emission shape.
+
+Mirrors obs/blackbox.py and obs/postmortem.py's literal emission
+sites: the recorder counts every ring append and every overwrite
+drop, each atomic dump, and the bundler counts every postmortem
+bundle it writes — every name carries a ctr row in the blackbox
+report fixture.
+"""
+
+
+def record(obs, dropped):
+    obs.count("blackbox_records")
+    if dropped:
+        obs.count("blackbox_dropped")
+
+
+def dump(obs):
+    obs.count("blackbox_dumps")
+
+
+def bundle(obs):
+    obs.count("postmortem_bundles")

@@ -1,0 +1,13 @@
+"""obs-names fixture: mini INSTRUMENTS table for the forensics plane.
+
+Rows match blackbox_good.py's emissions; `blackbox_dumps` is listed
+as a ctr so blackbox_bad.py's gauge emission is a kind-mismatch
+finding.
+"""
+
+INSTRUMENTS = {
+    "blackbox_records": {"kind": "ctr"},
+    "blackbox_dropped": {"kind": "ctr"},
+    "blackbox_dumps": {"kind": "ctr"},
+    "postmortem_bundles": {"kind": "ctr"},
+}

@@ -130,6 +130,19 @@ if ! python bench.py --serve-ab --smoke --perf-gate; then
     failed_files+=("bench.py --serve-ab --smoke")
 fi
 
+# Flight-recorder smoke: the recorder on/off overhead A/B
+# (obs/blackbox.py) plus the dump round-trip and no-stray-dump
+# checks. The full lane gates the on/off grad-steps/s ratio at the
+# 0.95 PERF.md floor; the smoke lane anti-ratchets against the last
+# comparable (same frames/smoke class) BLACKBOX_SMOKE.json — failing
+# runs never reseed the baseline.
+echo
+echo "=== bench.py --blackbox-ab --smoke"
+if ! python bench.py --blackbox-ab --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --blackbox-ab --smoke")
+fi
+
 # Chaos-remediation smoke: the three-arm availability drill (clean /
 # chaos / chaos+remediation) from bench.py --chaos-ab. The remediated
 # arm must beat the last comparable (same window/clients)
@@ -137,7 +150,9 @@ fi
 # remediation plane keeps EARNING its availability win, not just that
 # it once did; failing runs never reseed the baseline. (The 0.822
 # PERF.md floor applies only to the full lane — the smoke window is
-# too short for an absolute bound.)
+# too short for an absolute bound.) The drill also hard-gates its own
+# forensics: the postmortem bundle must exist and its root-cause walk
+# must attribute the injected kill/wedge by component name.
 echo
 echo "=== bench.py --chaos-ab --smoke"
 if ! python bench.py --chaos-ab --smoke --perf-gate; then

@@ -469,6 +469,24 @@ def test_obs_names_serve_fixtures():
     assert len(bad.findings) == 2
 
 
+def test_obs_names_blackbox_fixtures():
+    """The forensics fixture pair (ISSUE 17): the good emitter's
+    ring/dump/bundle counters cross-reference cleanly against the
+    mini table; the bad emitter drifts both ways (dumps emitted as a
+    gauge, an unlisted scratch counter)."""
+    report = _fx("blackbox_report_fixture.py")
+    good = obs_names.check([_fx("blackbox_good.py")], report)
+    assert good.findings == []
+    assert good.waivers == 0
+
+    bad = obs_names.check(
+        [_fx("blackbox_good.py"), _fx("blackbox_bad.py")], report)
+    msgs = [f.message for f in bad.findings]
+    assert any("blackbox_dumps" in m for m in msgs)  # ctr-vs-gauge
+    assert any("blackbox_scratch" in m for m in msgs)  # unlisted
+    assert len(bad.findings) == 2
+
+
 def test_config_coverage_serving_scope(tmp_path):
     """ServingConfig is in the README-knob scope (ISSUE 13): a README
     naming a nonexistent serving.<knob> fails, a real knob passes, and

@@ -13,11 +13,20 @@ re-run exactly from a log or bench artifact (same seed, same
 scenario, same phase schedule). A scenario takes over fault control:
 its clean phases reset ALL rates, including ones given on the
 command line.
+
+Forensics: `--cycles N --forensics-dir DIR` bounds a scenario to N
+full phase cycles and turns the drill into a postmortem assertion —
+the proxy keeps its own flight recorder of every injected fault
+(obs/blackbox.py), dumps it into DIR next to whatever blackbox dumps
+the fleet under test wrote there, bundles the lot
+(obs/postmortem.py), and exits nonzero unless the bundle's root-cause
+walk attributes the drill to an injected component by name.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -59,6 +68,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="named fault-schedule preset; phase "
                          "transitions are printed so the drill can be "
                          "re-run exactly from any log")
+    ap.add_argument("--cycles", type=int, default=0,
+                    help="with --scenario: stop after N full phase "
+                         "cycles instead of running forever (0 = "
+                         "forever)")
+    ap.add_argument("--forensics-dir", default=None, metavar="DIR",
+                    help="record every injected fault into a flight "
+                         "recorder, dump it to DIR on drill end, "
+                         "bundle DIR's blackbox dumps into "
+                         "POSTMORTEM.json, and exit nonzero unless "
+                         "the root cause names an injected component")
     args = ap.parse_args(argv)
     host, port = args.connect.rsplit(":", 1)
     proxy = ChaosProxy(host, int(port), listen_port=args.listen,
@@ -68,9 +87,13 @@ def main(argv: list[str] | None = None) -> int:
     scen = f" scenario={args.scenario}" if args.scenario else ""
     print(f"chaos proxy: :{proxy.port} -> {host}:{port} "
           f"seed={args.seed}{scen}", flush=True)
+    recorder = None
+    if args.forensics_dir:
+        recorder = _make_recorder(args.forensics_dir)
     try:
         if args.scenario:
-            _run_scenario(proxy, args.scenario)
+            _run_scenario(proxy, args.scenario, recorder=recorder,
+                          cycles=args.cycles)
         else:
             _run_static(proxy, args.cut_every)
     except KeyboardInterrupt:
@@ -78,6 +101,59 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         proxy.stop()
         print(f"chaos proxy stats: {proxy.stats}", file=sys.stderr)
+    if recorder is not None:
+        return _bundle_and_attribute(args.forensics_dir, recorder)
+    return 0
+
+
+def _make_recorder(forensics_dir: str):
+    """Flight recorder for the proxy's OWN injected-fault log — the
+    drill's ground truth, dumped next to the victims' boxes."""
+    from ape_x_dqn_tpu.obs.blackbox import FlightRecorder
+
+    class _Sink:  # minimal obs facade (the proxy has no Obs plane)
+        def __init__(self):
+            self.ctr: dict[str, int] = {}
+
+        def count(self, name, n=1):
+            self.ctr[name] = self.ctr.get(name, 0) + n
+
+    os.makedirs(forensics_dir, exist_ok=True)
+    return FlightRecorder(_Sink(), peer="chaos-proxy",
+                          out_dir=forensics_dir)
+
+
+# components the proxy's fault primitives act on; the postmortem root
+# cause must name one of these (or a victim's own dump must)
+_INJECTED = ("link",)
+
+
+def _bundle_and_attribute(forensics_dir: str, recorder) -> int:
+    """Dump the proxy's own box, bundle every blackbox-*.json in the
+    forensics dir, walk the merged timeline backwards, and demand the
+    root cause name an injected component."""
+    from ape_x_dqn_tpu.obs import postmortem, report
+
+    recorder.dump("drill_complete", component="chaos-proxy")
+    bpath = os.path.join(forensics_dir, "POSTMORTEM.json")
+    bundle = postmortem.build_bundle(forensics_dir, out_path=bpath)
+    root = report.postmortem_root_cause(bundle) or {}
+    events = [e for e in (root.get("anomaly"), root.get("terminal"))
+              if e]
+    victims = [c for d in bundle["dumps"]
+               if d.get("peer") != "chaos-proxy"
+               for c in (d.get("component"),) if c]
+    named = set(_INJECTED) | set(victims)
+    attributed = any(e.get("component") in named for e in events)
+    rc_line = report.format_postmortem(bundle).splitlines()[-1]
+    print(f"chaos forensics: bundle {bpath} ({len(bundle['dumps'])} "
+          f"dumps, {len(bundle['skipped_dumps'])} skipped) — "
+          f"{rc_line}", flush=True)
+    if not bundle["dumps"] or not attributed:
+        print(f"chaos forensics FAIL: root cause does not attribute "
+              f"an injected/victim component ({sorted(named)})",
+              file=sys.stderr)
+        return 1
     return 0
 
 
@@ -92,23 +168,38 @@ def _run_static(proxy: ChaosProxy, cut_every: float) -> None:
             print(f"chaos proxy: cut {n} sockets", flush=True)
 
 
-def _run_scenario(proxy: ChaosProxy, name: str) -> None:
+def _run_scenario(proxy: ChaosProxy, name: str, recorder=None,
+                  cycles: int = 0) -> None:
     phases = SCENARIOS[name]
     i = 0
     while True:
+        if cycles > 0 and i >= cycles * len(phases):
+            return
         duration, action = phases[i % len(phases)]
         if action == "cut":
             n = proxy.cut()
             print(f"chaos scenario {name}: cut {n} sockets",
                   flush=True)
+            if recorder is not None:
+                recorder.record("kill", component="link",
+                                scenario=name, sockets=n)
         elif action == "clean":
             proxy.clean()
             print(f"chaos scenario {name}: clean", flush=True)
+            if recorder is not None:
+                recorder.record("remediation", component="link",
+                                scenario=name, action="clean")
         else:
             proxy.set_fault(**action)
             print(f"chaos scenario {name}: set_fault {action}",
                   flush=True)
-        if duration > 0:
+            if recorder is not None:
+                recorder.record("wedge", component="link",
+                                scenario=name, **action)
+        # a bounded drill skips the final phase's dwell: the schedule
+        # is over, only the bundle assertion remains
+        last = cycles > 0 and i + 1 >= cycles * len(phases)
+        if duration > 0 and not last:
             time.sleep(duration)
         i += 1
 
