@@ -114,7 +114,13 @@ def _addr(data: Buffer) -> tuple[ctypes.c_void_p, int, object]:
         return (ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p),
                 len(b), b)
     arr = (ctypes.c_ubyte * n).from_buffer(mv)
-    return ctypes.cast(arr, ctypes.c_void_p), n, (arr, mv)
+    # addressof, NOT ctypes.cast(arr, ...): cast's keepalive bookkeeping
+    # puts the array into a reference cycle, so the buffer export it
+    # holds survives until a gc pass — which pins shared-memory
+    # segments (BufferError on SharedMemory.close) long after the call
+    # returned. addressof is a plain int; the _keep tuple alone bounds
+    # the export's lifetime to this call, released by refcount.
+    return ctypes.c_void_p(ctypes.addressof(arr)), n, (arr, mv)
 
 
 def crc32(data: Buffer, seed: int = 0) -> int:

@@ -89,6 +89,28 @@ per-actor polling into epoch-versioned publication. An old server
 ignores the request payload and replies with the legacy raw pickle;
 an old client sends an empty request and gets exactly that — the
 param path interops both ways with pre-epoch builds.
+
+SHARED-MEMORY SAME-HOST PLANE (MSG_SHM_DOORBELL, comm/shm_transport.py):
+a client whose hello carries an "shm" offer — boot id plus a namespace
+probe segment the server must attach and read back, so only a true
+same-host/same-IPC-namespace peer ever qualifies — is granted a
+per-connection experience ring and the shared seqlock param area, named
+in the hello ack. Experience then packs STRAIGHT into a claimed ring
+slot (no codec, no sendall of the body; the actor-side pack is the one
+copy, the learner-side staging landing the other half of the existing
+invariant) and a ~24-byte MSG_SHM_DOORBELL frame on this same TCP
+socket names the slot, so reconnect/backoff, epoch machinery,
+backpressure latches, chaos injection and drop accounting all keep
+working on the control plane they already own. The server validates
+seq + crc before delivering — torn slots (writer died mid-write, wild
+writes) are counted and freed, never delivered — and reclaims every
+lease when the connection drops. Params publish once into the seqlock
+area; granted clients read it locally (per-client MSG_PARAMS blob
+pulls and params_push frames stop entirely for them). EVERY shm
+failure mode — old peer (the offer/grant keys are ignored like any
+unknown capability), cross-host peer, probe failure, full ring,
+oversize batch or blob, torn read — degrades silently to the TCP paths
+above, which remain bitwise unchanged when comm.shm is off.
 """
 
 from __future__ import annotations
@@ -108,7 +130,7 @@ from typing import Any
 
 import numpy as np
 
-from ape_x_dqn_tpu.comm import native
+from ape_x_dqn_tpu.comm import native, shm_transport
 from ape_x_dqn_tpu.obs.health import make_lock
 
 MAGIC = 0x41504558  # 'APEX'
@@ -120,6 +142,12 @@ MSG_HELLO_ACK = 5      # server's codec choice (JSON)
 MSG_EXPERIENCE_C = 6   # experience payload with codec-encoded leaves
 MSG_TELEMETRY = 7      # per-peer obs snapshot frame (JSON), negotiated
 MSG_PARAMS_PUSH = 8    # server-initiated params (negotiated subscribers)
+MSG_SHM_DOORBELL = 9   # same-host shm slot announcement (negotiated)
+
+# doorbell payload: slot index, slot seq, payload nbytes, payload crc.
+# ~24 bytes on the control socket announce a multi-MB slot — the whole
+# experience body moved through shared memory (comm/shm_transport.py)
+_DOORBELL = struct.Struct("<IQQI")
 
 WIRE_CODECS = ("raw", "delta-deflate")
 
@@ -251,6 +279,17 @@ def _decode_leaf_full(m: dict, rec, cache: dict | None = None) -> np.ndarray:
     """Materialize one array leaf (any encoding) as a fresh array."""
     dt, sh, enc = np.dtype(m["dt"]), m["sh"], m.get("enc")
     if enc is None:
+        # the .copy() is load-bearing, not a convenience: the returned
+        # array must OWN its memory because `rec` aliases a transport
+        # buffer with a shorter lifetime — for a ShmSlotBatch it is a
+        # ring slot that the writer REUSES the moment release() frees
+        # it (a view would silently mutate under the consumer), and
+        # even for TCP payloads a view would pin the entire multi-MB
+        # frame alive for the lifetime of one decoded leaf. The
+        # one-copy hot path is decode_into (no copy here, lands
+        # straight in staging); this full-materialize path only serves
+        # dict-protocol consumers. Pinned by test_comm.py
+        # (test_decode_leaf_full_copies_are_load_bearing).
         return np.frombuffer(rec, dtype=dt).reshape(sh).copy()
     cache = cache if cache is not None else _new_cache()
     full = cache["full"].get(m["k"])
@@ -262,6 +301,12 @@ def _decode_leaf_full(m: dict, rec, cache: dict | None = None) -> np.ndarray:
         arr = np.unpackbits(np.frombuffer(buf, np.uint8),
                             count=n).view(np.bool_).reshape(sh)
     elif enc in ("d", "xd"):
+        # load-bearing copy #2: zlib.decompress returns immutable
+        # bytes, and the "xd" undo below XORs rows IN PLACE — the copy
+        # is what buys writable memory. It doubles as ownership for
+        # "d" leaves: `buf` lives in the per-payload cache, which this
+        # returned array must outlive. Pinned by the same test as the
+        # raw-leaf copy above.
         arr = np.frombuffer(buf, dtype=dt).reshape(sh).copy()
         if enc == "xd" and arr.shape[0] > 1:
             native.delta_undo_inplace(
@@ -507,6 +552,54 @@ class WireBatch:
         return any(m["k"] == key for m in meta)
 
 
+class ShmSlotBatch(WireBatch):
+    """An experience batch living in a server-owned shm ring slot.
+
+    The payload memoryview aliases the shared segment (zero copies so
+    far — the actor's pack into the slot was the only one); all the
+    WireBatch decode machinery works unchanged because the slot holds
+    an exact raw wire payload. release() hands the slot back to the
+    writer once the consumer has landed the rows (IngestStager.put, the
+    legacy stage path, or a queue drop-oldest eviction); it must drop
+    every memoryview into the segment first, or the ring could never
+    unmap after its connection dies. Idempotent, with a __del__ net so
+    an exotic consumer that never releases (tests poking the queue)
+    leaks a slot for a bounded time, not forever."""
+
+    __slots__ = ("_ring", "_slot", "_released")
+
+    def __init__(self, view: memoryview, ring, slot: int):
+        super().__init__(view)
+        self._ring = ring
+        self._slot = slot
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        recs, self._recs = self._recs, None
+        self._cache = None
+        if recs is not None:
+            for r in recs:
+                try:
+                    r.release()
+                except BufferError:
+                    pass  # aliased by a live array; __del__/GC frees it
+        payload, self.payload = self.payload, b""
+        try:
+            payload.release()
+        except (BufferError, AttributeError):
+            pass
+        self._ring.free(self._slot)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
 def batch_rows(batch) -> int:
     """Staging units in an ingest message, cheap for both forms: wire
     batches read their JSON header; dict batches read priorities."""
@@ -567,7 +660,9 @@ class SocketIngestServer:
                  max_pending: int = 64, idle_grace_s: float = 5.0,
                  param_wire_dtype: str = "bfloat16",
                  wire_codec: str = "delta-deflate",
-                 epoch: int | None = None):
+                 epoch: int | None = None, shm: bool = False,
+                 shm_slots: int = 8, shm_slot_bytes: int = 1 << 22,
+                 shm_param_bytes: int = 1 << 26):
         """param_wire_dtype: dtype for float params on the wire.
         "bfloat16" (default) halves the weight-broadcast bytes — the
         round-3 soak measured param pulls saturating a bandwidth-
@@ -588,7 +683,15 @@ class SocketIngestServer:
         id, so a restarted server (a new incarnation at the same
         address) presents a different epoch and clients re-converge;
         pass an explicit value to pin it (tests, deterministic
-        fleets)."""
+        fleets).
+
+        shm: grant same-host shared-memory transport to clients whose
+        hello offer passes the boot-id + namespace probe
+        (comm/shm_transport.py). shm_slots/shm_slot_bytes cap the
+        per-connection experience ring a client may request;
+        shm_param_bytes sizes the one shared seqlock param area. Off
+        by default — TCP-only paths are bitwise unchanged when
+        disabled."""
         if param_wire_dtype not in ("bfloat16", "float32"):
             raise ValueError(
                 f"param_wire_dtype must be 'bfloat16' or 'float32', "
@@ -662,6 +765,20 @@ class SocketIngestServer:
         self._param_pushes = 0  # guarded-by: _conns_lock
         self._push_wake = threading.Event()
         self._push_thread: threading.Thread | None = None
+        # same-host shm plane (comm/shm_transport.py): one experience
+        # ring per granted connection, one param seqlock area for all
+        self._shm_enabled = bool(shm)
+        self._shm_slots = int(shm_slots)
+        self._shm_slot_bytes = int(shm_slot_bytes)
+        self._shm_param_bytes = int(shm_param_bytes)
+        self._conn_shm: dict[int, Any] = {}  # guarded-by: _conns_lock
+        self._shm_param_area: Any = None  # guarded-by: _lock
+        self._shm_doorbells = 0  # guarded-by: _conns_lock
+        self._shm_torn_slots = 0  # guarded-by: _conns_lock
+        self._shm_fallbacks = 0  # guarded-by: _conns_lock
+        self._shm_reclaimed = 0  # guarded-by: _conns_lock
+        self._shm_dropped = 0  # guarded-by: _conns_lock
+        self._shm_bytes_in = 0  # guarded-by: _conns_lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ingest-accept", daemon=True)
         self._accept_thread.start()
@@ -682,11 +799,18 @@ class SocketIngestServer:
                 return
             except queue.Full:
                 try:
-                    self._q.get_nowait()
+                    old = self._q.get_nowait()
                     # every reader thread and local actors land here on
                     # a full queue; a bare += across threads loses drops
                     with self._conns_lock:
                         self._dropped += 1
+                        if isinstance(old, ShmSlotBatch):
+                            self._shm_dropped += 1
+                    # an evicted shm batch must hand its slot back, or
+                    # backpressure would leak the writer's ring dry
+                    rel = getattr(old, "release", None)
+                    if rel is not None:
+                        rel()
                 except queue.Empty:
                     pass
 
@@ -841,6 +965,61 @@ class SocketIngestServer:
             return out
 
     @property
+    def shm_doorbells(self) -> int:
+        """Experience batches delivered through shm ring slots."""
+        with self._conns_lock:
+            return self._shm_doorbells
+
+    @property
+    def shm_torn_slots(self) -> int:
+        """Doorbells whose slot failed seq/crc/framing validation —
+        detected torn, freed, never delivered."""
+        with self._conns_lock:
+            return self._shm_torn_slots
+
+    @property
+    def shm_fallbacks(self) -> int:
+        """TCP experience frames received from connections that hold
+        an shm grant (ring-full / oversize degradations)."""
+        with self._conns_lock:
+            return self._shm_fallbacks
+
+    @property
+    def shm_reclaimed(self) -> int:
+        """Slot leases reclaimed from writers that disconnected with
+        claims outstanding (died mid-write or before the doorbell)."""
+        with self._conns_lock:
+            return self._shm_reclaimed
+
+    @property
+    def shm_dropped(self) -> int:
+        """Shm-delivered batches evicted by the drop-oldest queue
+        policy (their slots were freed at eviction)."""
+        with self._conns_lock:
+            return self._shm_dropped
+
+    @property
+    def shm_bytes_in(self) -> int:
+        """Experience payload bytes that crossed via shm slots (the
+        loopback bytes the TCP accounting no longer sees)."""
+        with self._conns_lock:
+            return self._shm_bytes_in
+
+    @property
+    def shm_slots_inflight(self) -> int:
+        """Ring slots currently claimed across all granted
+        connections (writer-claimed + delivered-not-yet-freed)."""
+        with self._conns_lock:
+            rings = list(self._conn_shm.values())
+        return sum(r.inflight for r in rings)
+
+    @property
+    def shm_rings(self) -> int:
+        """Connections currently holding an shm grant."""
+        with self._conns_lock:
+            return len(self._conn_shm)
+
+    @property
     def pending(self) -> int:
         return self._q.qsize()
 
@@ -903,6 +1082,16 @@ class SocketIngestServer:
             except OSError:  # apexlint: lossy(shutdown close best effort)
                 pass
         self._listener.close()
+        # shm teardown: the server owns every segment it granted
+        with self._conns_lock:
+            rings = list(self._conn_shm.values())
+            self._conn_shm.clear()
+        for ring in rings:
+            ring.destroy()
+        with self._lock:
+            area, self._shm_param_area = self._shm_param_area, None
+        if area is not None:
+            area.destroy()
 
     # internals
 
@@ -959,7 +1148,19 @@ class SocketIngestServer:
             self._push_wake.clear()
             with self._lock:
                 version = self._params[1]
+                area = self._shm_param_area
             cur = (self.epoch, version)
+            # the shm param area rides this thread (same serialization
+            # cost, same publish boundary) but dedupes on ITS OWN held
+            # (epoch, version): a grant arriving after the last publish
+            # must still land current params for the new attacher, even
+            # when every TCP subscriber is already up to date
+            if area is not None and version >= 0 and area.holds != cur:
+                epoch = self.epoch
+                with self._lock:
+                    blob = self._build_blob_locked()
+                    aver = self._params[1]
+                area.write(blob, epoch, aver)
             if cur == sent or version < 0:
                 continue
             payload = self._versioned_params_reply(-1, -1)
@@ -975,6 +1176,53 @@ class SocketIngestServer:
                 except OSError:  # apexlint: lossy(subscriber dropped; reader attributes the disconnect)
                     with self._conns_lock:
                         self._push_subs.pop(id(conn), None)
+
+    def _grant_shm(self, conn: socket.socket,
+                   req: dict) -> dict[str, Any] | None:
+        """Verify a hello shm offer and, if it proves same-host, build
+        the grant: a fresh per-connection experience ring plus the
+        (shared, lazily created) param seqlock area. Any failure —
+        probe refused, /dev/shm unavailable, garbage offer — returns
+        None and the connection stays plain TCP."""
+        try:
+            if not shm_transport.check_probe(
+                    str(req.get("probe", "")), str(req.get("token", "")),
+                    str(req.get("boot", ""))):
+                return None
+            slots = max(1, min(int(req.get("slots") or self._shm_slots),
+                               self._shm_slots))
+            slot_bytes = max(1 << 16,
+                             min(int(req.get("slot_bytes")
+                                     or self._shm_slot_bytes),
+                                 self._shm_slot_bytes))
+            ring = shm_transport.ShmRingServer(slots, slot_bytes)
+        except (OSError, ValueError, TypeError):  # apexlint: lossy(shm unavailable -> grant refused, TCP still works)
+            return None
+        with self._conns_lock:
+            self._conn_shm[id(conn)] = ring
+        grant: dict[str, Any] = {"ring": ring.name, "slots": ring.slots,
+                                 "slot_bytes": ring.slot_bytes}
+        area = self._ensure_param_area()
+        if area is not None:
+            grant["params"] = area.name
+        return grant
+
+    def _ensure_param_area(self) -> Any:
+        """Create the shared param seqlock area on the first shm grant
+        and (re)arm the push thread so CURRENT params land in it — a
+        client attaching long after the last publish must not read an
+        empty area until the next training publish."""
+        with self._lock:
+            if self._shm_param_area is None:
+                try:
+                    self._shm_param_area = shm_transport.ShmParamArea(
+                        self._shm_param_bytes)
+                except (OSError, ValueError):  # apexlint: lossy(area unavailable -> clients pull params over TCP)
+                    return None
+            area = self._shm_param_area
+        self._ensure_push_thread()
+        self._push_wake.set()
+        return area
 
     def _reader(self, conn: socket.socket) -> None:
         try:
@@ -1012,6 +1260,51 @@ class SocketIngestServer:
                         self._ever_connected = True
                         self._bytes_in += len(payload)
                         self._raw_bytes_in += raw
+                        # a TCP experience frame from a connection that
+                        # holds an shm grant is a FALLBACK (ring full /
+                        # oversize batch) — the server-visible half of
+                        # the client's degradation accounting
+                        if id(conn) in self._conn_shm:
+                            self._shm_fallbacks += 1
+                    self.send_experience(batch)
+                elif mtype == MSG_SHM_DOORBELL:
+                    # same-host data plane: the payload crossed in a
+                    # shared-memory slot; this tiny frame only names it.
+                    # Validation (seq + crc over the slot) runs before
+                    # anything is delivered — a torn slot (writer died
+                    # mid-write, wild write, stale doorbell) is counted
+                    # and freed, never enqueued, and does NOT fault the
+                    # connection: the control socket itself framed fine.
+                    with self._conns_lock:
+                        ring = self._conn_shm.get(id(conn))
+                    if ring is None:
+                        raise ValueError("shm doorbell without a grant")
+                    try:
+                        slot, seq, nbytes, crc = _DOORBELL.unpack(payload)
+                    except struct.error:
+                        raise ValueError("bad shm doorbell frame")
+                    view = ring.take(slot, seq, nbytes, crc)
+                    batch = None
+                    if view is not None:
+                        batch = ShmSlotBatch(view, ring, slot)
+                        try:
+                            batch.rows  # noqa: B018 - framing validation
+                        except (ValueError, KeyError):
+                            batch.release()  # frees the slot
+                            batch = None
+                    if batch is None:
+                        with self._conns_lock:
+                            self._shm_torn_slots += 1
+                            who = self._conn_peers.get(
+                                id(conn), "unidentified")
+                        cb = self.on_decode_error
+                        if cb is not None and not self._stop.is_set():
+                            cb(who, "torn shm slot")
+                        continue
+                    with self._conns_lock:
+                        self._ever_connected = True
+                        self._shm_doorbells += 1
+                        self._shm_bytes_in += nbytes
                     self.send_experience(batch)
                 elif mtype == MSG_HELLO:
                     # codec negotiation: grant the configured codec iff
@@ -1022,6 +1315,7 @@ class SocketIngestServer:
                     # old client never does, so this server never
                     # expects frames from it).
                     serve_tag: tuple[str, int] | None = None
+                    shm_req: dict | None = None
                     try:
                         hello = json.loads(bytes(payload))
                         offered = hello.get("codecs", [])
@@ -1036,11 +1330,21 @@ class SocketIngestServer:
                         if isinstance(serve, dict) and serve.get("policy"):
                             serve_tag = (str(serve["policy"]),
                                          int(serve.get("class", 0)))
+                        # same-host shm offer (PR 4/6/13 capability
+                        # idiom again): an old client never offers, an
+                        # old server ignores the key — TCP either way
+                        req = hello.get("shm")
+                        if isinstance(req, dict):
+                            shm_req = req
                     except (ValueError, AttributeError, TypeError):
                         offered, wants_tel, wants_push = [], False, False
                         serve_tag = None
+                        shm_req = None
                     grant = self._codec if self._codec in offered \
                         else "raw"
+                    shm_grant = self._grant_shm(conn, shm_req) \
+                        if self._shm_enabled and shm_req is not None \
+                        else None
                     # the epoch rides every ack: an old client never
                     # hellos (never sees it), a new client uses it to
                     # distinguish a blip from a new incarnation
@@ -1048,8 +1352,14 @@ class SocketIngestServer:
                                            "epoch": self.epoch}
                     if wants_tel:
                         ack["telemetry"] = True
-                    if wants_push:
+                    # the shm param area SUPERSEDES per-connection param
+                    # pushes for a granted client: its get_params reads
+                    # the seqlock area, so shipping the same blob down
+                    # this socket too would be pure duplicate bytes
+                    if wants_push and shm_grant is None:
                         ack["params_push"] = True
+                    if shm_grant is not None:
+                        ack["shm"] = shm_grant
                     if serve_tag is not None:
                         with self._conns_lock:
                             self._conn_serve[id(conn)] = serve_tag
@@ -1063,7 +1373,7 @@ class SocketIngestServer:
                     # wedging the push thread in sendall on a full window
                     self._send_on(conn, MSG_HELLO_ACK,
                                   json.dumps(ack).encode())
-                    if wants_push:
+                    if wants_push and shm_grant is None:
                         with self._conns_lock:
                             self._push_subs[id(conn)] = conn
                         self._ensure_push_thread()
@@ -1128,10 +1438,19 @@ class SocketIngestServer:
                 self._conn_send_locks.pop(id(conn), None)
                 self._push_subs.pop(id(conn), None)
                 self._conn_serve.pop(id(conn), None)
+                ring = self._conn_shm.pop(id(conn), None)
                 self._last_disconnect = time.monotonic()
                 peer = self._conn_peers.pop(id(conn), None)
                 if peer is not None:
                     self._peer_disconnects += 1
+            if ring is not None:
+                # lease reclaim: a writer that died mid-write left
+                # claimed slots no doorbell will ever name — retire()
+                # counts them, unlinks the segment, and defers the
+                # unmap until queued batches drain
+                reclaimed = ring.retire()
+                with self._conns_lock:
+                    self._shm_reclaimed += reclaimed
             if peer is not None and not self._stop.is_set():
                 # a lost actor is an attributed event, never silence
                 logging.getLogger(__name__).warning(
@@ -1228,7 +1547,9 @@ class SocketTransport:
                  reconnect_base_s: float = 0.05,
                  reconnect_cap_s: float = 2.0,
                  params_push: bool = False,
-                 serve_policy: str = "", serve_class: int = 0):
+                 serve_policy: str = "", serve_class: int = 0,
+                 shm: bool = False, shm_slots: int = 8,
+                 shm_slot_bytes: int = 1 << 22):
         """telemetry: offer the fleet-telemetry capability in the
         connect-time hello. send_telemetry only ships frames after the
         server granted it, so leaving this on against an old server
@@ -1251,7 +1572,16 @@ class SocketTransport:
         the capability; an old server ignores the unknown offer key —
         experience flows untagged either way. The tag also arms
         set_backpressure: the serving tier's admission controller can
-        then shed THIS host's sends during overload windows."""
+        then shed THIS host's sends during overload windows.
+
+        shm: offer the same-host shared-memory transport in the hello
+        (with a boot-id + namespace probe proving same-host). When the
+        server grants it, experience packs straight into ring slots
+        (MSG_SHM_DOORBELL on this socket names them) and params read
+        from the server's seqlock area; every shm failure mode —
+        cross-host peer, old server, full ring, oversize batch, torn
+        read — degrades to the plain TCP paths, counted. shm_slots/
+        shm_slot_bytes shape the ring requested from the server."""
         self._addr = (host, port)
         self._timeout = connect_timeout
         self._codec = _check_codec(wire_codec)
@@ -1314,6 +1644,22 @@ class SocketTransport:
         # (_bytes_out and friends: payload bytes shipped vs their
         # uncompressed size, cumulative encode wall-ms, param blob
         # bytes pulled — the soak's link-budget accounting)
+        # same-host shm plane: the ring writer lives under _send_lock
+        # with the socket it was negotiated with; the param reader is
+        # assigned whole under _send_lock but READ lock-free in
+        # get_params (GIL-atomic reference swap, the _bp_engaged idiom)
+        # because the param path must never contend with sends
+        self._shm_enabled = bool(shm)
+        self._shm_slots = int(shm_slots)
+        self._shm_slot_bytes = int(shm_slot_bytes)
+        self._shm_boot_id = shm_transport.boot_id()  # test seam
+        self._shm_ring: Any = None  # guarded-by: _send_lock
+        self._shm_param_reader: Any = None
+        self._shm_posts = 0  # guarded-by: _send_lock
+        self._shm_fallbacks = 0  # guarded-by: _send_lock
+        self._shm_bytes_out = 0  # guarded-by: _send_lock
+        self._shm_param_reads = 0  # guarded-by: _param_lock
+        self._shm_param_fallbacks = 0  # guarded-by: _param_lock
         self._send_lock = make_lock("transport._send_lock")
         self._param_lock = make_lock("transport._param_lock")
         self._meta_lock = make_lock("transport._meta_lock")
@@ -1350,6 +1696,10 @@ class SocketTransport:
             except OSError:  # apexlint: lossy(close of an already-dead socket)
                 pass
             self._sock = None  # apexlint: unguarded(caller holds _send_lock)
+        # shm rode this connection's grant: the server reclaims the
+        # segments once it notices the disconnect, so detach now and
+        # renegotiate on reconnect
+        self._detach_shm()
         if self._disconnected_at is None:
             self._disconnected_at = time.monotonic()  # apexlint: unguarded(caller holds _send_lock)
         self._consec_fails += 1  # apexlint: unguarded(caller holds _send_lock)
@@ -1411,18 +1761,35 @@ class SocketTransport:
         self._telemetry_ok = False  # apexlint: unguarded(caller holds _send_lock)
         self._push_ok = False  # apexlint: unguarded(caller holds _send_lock)
         self._serve_ok = False  # apexlint: unguarded(caller holds _send_lock)
+        # shm attachments belong to the PREVIOUS connection's grant —
+        # the server retires those segments on our disconnect, so a
+        # reconnect always renegotiates fresh ones
+        self._detach_shm()
+        probe = None
+        if self._shm_enabled:
+            try:
+                probe, probe_token = shm_transport.make_probe()
+            except (OSError, ValueError):  # apexlint: lossy(/dev/shm unavailable -> offer skipped, TCP as before)
+                probe = None
         if (self._codec != "raw" or self._telemetry
-                or self._params_push or self._serve_policy):
+                or self._params_push or self._serve_policy
+                or probe is not None):
             # the hello now also fires with a raw codec when telemetry
             # is wanted — an old server still just ignores it
             try:
-                offer = {"codecs": [self._codec],
-                         "telemetry": self._telemetry}
+                offer: dict[str, Any] = {"codecs": [self._codec],
+                                         "telemetry": self._telemetry}
                 if self._params_push:
                     offer["params_push"] = True
                 if self._serve_policy:
                     offer["serve"] = {"policy": self._serve_policy,
                                       "class": self._serve_class}
+                if probe is not None:
+                    offer["shm"] = {"boot": self._shm_boot_id,
+                                    "probe": probe.name,
+                                    "token": probe_token,
+                                    "slots": self._shm_slots,
+                                    "slot_bytes": self._shm_slot_bytes}
                 _send_msg(sock, MSG_HELLO, json.dumps(offer).encode())
                 sock.settimeout(self._hello_timeout)
                 msg = _recv_msg(sock)
@@ -1440,16 +1807,59 @@ class SocketTransport:
                     ep = ack.get("epoch")
                     if isinstance(ep, int):
                         self._note_epoch(ep)
+                    if probe is not None:
+                        self._attach_shm_grant(ack.get("shm"))
             except (OSError, ValueError, AttributeError):
                 pass  # apexlint: lossy(old server / timeout / garbage ack -> raw fallback)
             finally:
                 sock.settimeout(self._timeout)
+                if probe is not None:
+                    # the probe's job ended with the ack; unlink FIRST
+                    # (needs only the name — the filesystem entry is
+                    # what leaks), then close the mapping: a close()
+                    # failure (BufferError on a stray export) must not
+                    # leave the name behind in /dev/shm
+                    try:
+                        probe.unlink()
+                        probe.close()
+                    except (OSError, BufferError):  # apexlint: lossy(probe already gone)
+                        pass
         self._note_connected()
         if self._push_ok:
             threading.Thread(target=self._push_reader, args=(sock,),
                              name="params-push-reader",
                              daemon=True).start()
         return sock
+
+    def _attach_shm_grant(self, grant: Any) -> None:
+        """Attach the segments a hello ack granted (caller holds
+        _send_lock). Attach failure of either segment degrades that
+        plane to TCP — never to an error."""
+        if not isinstance(grant, dict):
+            return
+        try:
+            self._shm_ring = shm_transport.ShmRingWriter(  # apexlint: unguarded(caller holds _send_lock)
+                str(grant.get("ring", "")))
+        except (OSError, ValueError):  # apexlint: lossy(ring unattachable -> TCP experience, counted at first send)
+            self._shm_ring = None  # apexlint: unguarded(caller holds _send_lock)
+        params = grant.get("params")
+        if params:
+            try:
+                self._shm_param_reader = shm_transport.ShmParamReader(
+                    str(params))
+            except (OSError, ValueError):  # apexlint: lossy(area unattachable -> TCP param pulls)
+                self._shm_param_reader = None
+
+    def _detach_shm(self) -> None:
+        """Drop shm attachments (caller holds _send_lock). Detach
+        only — the segments are server-owned; it unlinks them when it
+        notices our disconnect."""
+        ring, self._shm_ring = self._shm_ring, None  # apexlint: unguarded(caller holds _send_lock)
+        if ring is not None:
+            ring.close()
+        reader, self._shm_param_reader = self._shm_param_reader, None
+        if reader is not None:
+            reader.close()
 
     def _push_reader(self, sock: socket.socket) -> None:
         """Reader for server-initiated MSG_PARAMS_PUSH frames on the
@@ -1565,6 +1975,36 @@ class SocketTransport:
                 try:
                     if self._sock is None:
                         self._sock = self._connect_experience()
+                    ring = self._shm_ring
+                    if ring is not None:
+                        # same-host fast path: pack straight into a
+                        # ring slot (the one copy — no codec, no
+                        # sendall of the body) and ring the doorbell
+                        # on this socket. A full ring or oversize
+                        # batch falls through to TCP for THIS batch
+                        # only, counted.
+                        t0 = time.perf_counter()
+                        post = ring.post(batch)
+                        self._encode_ms += (time.perf_counter()
+                                            - t0) * 1e3
+                        if post is not None:
+                            db = _DOORBELL.pack(*post)
+                            try:
+                                _send_msg(self._sock, MSG_SHM_DOORBELL,
+                                          db)
+                            except OSError:
+                                # the doorbell never left: un-claim the
+                                # slot before the reconnect path drops
+                                # the whole ring attachment
+                                ring.release(post[0])
+                                raise
+                            self._shm_posts += 1
+                            # shm bytes stay OUT of the raw/wire codec
+                            # ratio — only the doorbell touched TCP
+                            self._shm_bytes_out += post[2]
+                            self._bytes_out += len(db)
+                            return
+                        self._shm_fallbacks += 1
                     codec = self._negotiated
                     if payload is None or payload_codec != codec:
                         t0 = time.perf_counter()
@@ -1657,7 +2097,17 @@ class SocketTransport:
         raw pickle, which parses through the same path (epoch stays
         unknown, every pull ships the full blob). Any failure returns
         (None, -1) and bumps param_pull_errors; it never raises into
-        the puller thread."""
+        the puller thread.
+
+        With an shm grant on the current connection, the pull is a
+        LOCAL seqlock read of the server's param area — no socket, no
+        per-client blob; torn/oversize/unpublished reads fall back to
+        the TCP pull below, which is always correct."""
+        reader = self._shm_param_reader
+        if reader is not None:
+            got = self._shm_get_params(reader)
+            if got is not None:
+                return got
         with self._param_lock:
             req = json.dumps({"v": self._param_version,
                               "epoch": self._param_epoch}).encode()
@@ -1704,6 +2154,41 @@ class SocketTransport:
         if status == "unchanged":
             return None, version
         return params, version
+
+    def _shm_get_params(self, reader: Any) -> tuple[Any, int] | None:
+        """One attempt at a seqlock param read: (params, version) /
+        (None, version) for "unchanged", or None meaning 'use the TCP
+        pull' (nothing published to the area yet, blob oversize, torn
+        reads exhausted, or an undecodable blob)."""
+        with self._param_lock:
+            have = (self._param_epoch, self._param_version)
+        try:
+            res = reader.read(*have)
+        except (OSError, ValueError):  # apexlint: lossy(counted as shm_param_fallbacks below)
+            res = None
+        if res is None or res[0] in ("empty", "oversize"):
+            with self._param_lock:
+                self._shm_param_fallbacks += 1
+            return None
+        status, blob, ep, version = res
+        self._note_epoch(ep)
+        if status == "unchanged":
+            with self._param_lock:
+                self._param_unchanged += 1
+                self._shm_param_reads += 1
+            return None, version
+        try:
+            params, _ = pickle.loads(blob)
+        except Exception as e:
+            self._warn_bad_blob(e)
+            with self._param_lock:
+                self._shm_param_fallbacks += 1
+            return None
+        with self._param_lock:
+            self._param_epoch = ep
+            self._param_version = version
+            self._shm_param_reads += 1
+        return _upcast_bf16(params), version
 
     @property
     def dropped(self) -> int:
@@ -1805,6 +2290,46 @@ class SocketTransport:
         return self._negotiated
 
     @property
+    def shm_negotiated(self) -> bool:
+        """True while the current connection holds an shm experience
+        ring grant (False cross-host, against an old server, or after
+        any connection failure until the reconnect renegotiates)."""
+        return self._shm_ring is not None
+
+    @property
+    def shm_posts(self) -> int:
+        """Experience batches shipped through shm ring slots."""
+        with self._send_lock:
+            return self._shm_posts
+
+    @property
+    def shm_fallbacks(self) -> int:
+        """Batches that degraded to TCP despite a live shm grant
+        (ring full or batch outsized a slot)."""
+        with self._send_lock:
+            return self._shm_fallbacks
+
+    @property
+    def shm_bytes_out(self) -> int:
+        """Experience payload bytes that crossed via shm slots."""
+        with self._send_lock:
+            return self._shm_bytes_out
+
+    @property
+    def shm_param_reads(self) -> int:
+        """Param pulls satisfied by the seqlock area (incl. header-
+        only "unchanged" reads) — pulls that cost zero socket bytes."""
+        with self._param_lock:
+            return self._shm_param_reads
+
+    @property
+    def shm_param_fallbacks(self) -> int:
+        """Param pulls that fell back to TCP with a reader attached
+        (area unpublished/oversize, torn reads exhausted, bad blob)."""
+        with self._param_lock:
+            return self._shm_param_fallbacks
+
+    @property
     def serve_negotiated(self) -> bool:
         """True when the server acknowledged this host's serving-tier
         tenant tag on the current connection (False against an old
@@ -1844,6 +2369,7 @@ class SocketTransport:
 
     def close(self) -> None:
         with self._send_lock, self._param_lock:
+            self._detach_shm()
             for s in (self._sock, self._param_sock):
                 if s is not None:
                     try:

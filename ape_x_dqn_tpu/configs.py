@@ -387,6 +387,25 @@ class CommConfig:
     # is the universally-interoperable one; against a pre-push learner
     # the offer is silently ignored either way.
     params_push: bool = False
+    # Same-host shared-memory transport (comm/shm_transport.py):
+    # experience packs straight into a per-connection shm ring
+    # (MSG_SHM_DOORBELL names slots on the existing TCP socket) and
+    # params read from one seqlock area, engaging only when the hello's
+    # boot-id + namespace probe proves same-host. Off by default: the
+    # TCP paths are bitwise unchanged when disabled, and every shm
+    # failure mode (old peer, cross-host, full ring, torn read)
+    # degrades to them anyway. shm=True on BOTH learner (grant) and
+    # actor host (offer) sides engages it.
+    shm: bool = False
+    # per-connection experience ring geometry: slot count and bytes
+    # per slot (a batch outsizing a slot falls back to TCP, counted in
+    # shm_fallbacks). The learner side caps what an actor may request.
+    shm_slots: int = 8
+    shm_slot_bytes: int = 1 << 22
+    # seqlock param area capacity (learner side, one area shared by
+    # every granted client); an oversize pickled param blob publishes
+    # a marker instead and readers fall back to the TCP param path
+    shm_param_bytes: int = 1 << 26
 
 
 @dataclass(frozen=True)

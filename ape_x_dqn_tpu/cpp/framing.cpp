@@ -15,9 +15,15 @@
 
 extern "C" {
 
-// CRC-32 (IEEE 802.3, reflected 0xEDB88320), table-based, one pass.
-// Matches zlib.crc32 so the Python fallback is wire-compatible.
-static uint32_t CRC_TABLE[256];
+// CRC-32 (IEEE 802.3, reflected 0xEDB88320), slice-by-8, one pass.
+// Matches zlib.crc32 so the Python fallback is wire-compatible. The
+// byte-at-a-time table loop this replaces ran at ~190 MB/s — slower
+// than the memcpy it was guarding, and the single largest cost on the
+// shm slot path, which crcs the full RAW payload per post (the TCP
+// path only crcs the post-deflate bytes). Slice-by-8 processes two
+// 32-bit words per step through eight derived tables (~1.5 GB/s),
+// putting the checksum back under the copy it protects.
+static uint32_t CRC_TABLE[8][256];
 static bool crc_init_done = false;
 
 static void crc_init() {
@@ -25,7 +31,16 @@ static void crc_init() {
         uint32_t c = i;
         for (int k = 0; k < 8; ++k)
             c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-        CRC_TABLE[i] = c;
+        CRC_TABLE[0][i] = c;
+    }
+    // table[t][b] = crc of byte b followed by t zero bytes: lets one
+    // step fold 8 input bytes with 8 independent lookups
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = CRC_TABLE[0][i];
+        for (int t = 1; t < 8; ++t) {
+            c = CRC_TABLE[0][c & 0xFFu] ^ (c >> 8);
+            CRC_TABLE[t][i] = c;
+        }
     }
     crc_init_done = true;
 }
@@ -33,8 +48,24 @@ static void crc_init() {
 uint32_t apex_crc32(const uint8_t* buf, uint64_t len, uint32_t seed) {
     if (!crc_init_done) crc_init();
     uint32_t c = seed ^ 0xFFFFFFFFu;
-    for (uint64_t i = 0; i < len; ++i)
-        c = CRC_TABLE[(c ^ buf[i]) & 0xFFu] ^ (c >> 8);
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
+    // the word folding below assumes little-endian lane order; the
+    // byte loop after it is the (correct) big-endian fallback
+    while (len >= 8) {
+        uint32_t lo, hi;  // memcpy: unaligned-safe, aliasing-clean
+        std::memcpy(&lo, buf, 4);
+        std::memcpy(&hi, buf + 4, 4);
+        lo ^= c;
+        c = CRC_TABLE[7][lo & 0xFFu] ^ CRC_TABLE[6][(lo >> 8) & 0xFFu]
+          ^ CRC_TABLE[5][(lo >> 16) & 0xFFu] ^ CRC_TABLE[4][lo >> 24]
+          ^ CRC_TABLE[3][hi & 0xFFu] ^ CRC_TABLE[2][(hi >> 8) & 0xFFu]
+          ^ CRC_TABLE[1][(hi >> 16) & 0xFFu] ^ CRC_TABLE[0][hi >> 24];
+        buf += 8;
+        len -= 8;
+    }
+#endif
+    while (len--)
+        c = CRC_TABLE[0][(c ^ *buf++) & 0xFFu] ^ (c >> 8);
     return c ^ 0xFFFFFFFFu;
 }
 

@@ -268,6 +268,18 @@ INSTRUMENTS = {
     "blackbox_dumps": {"kind": "ctr"},
     "blackbox_dropped": {"kind": "ctr"},
     "postmortem_bundles": {"kind": "ctr"},
+    # shared-memory same-host transport (ISSUE 18): doorbells are
+    # slot deliveries on the zero-copy ring; torn slots (crc/seq
+    # mismatch — writer died mid-pack or wild write) are counted and
+    # freed, NEVER delivered; fallbacks are batches a granted
+    # connection still shipped over TCP (ring full / oversize batch).
+    # A nonzero torn rate or a fallback-dominated mix means the ring
+    # is mis-sized for the batch shape — see README "Shared-memory
+    # same-host transport".
+    "shm_doorbells": {"kind": "ctr"},
+    "shm_torn_slots": {"kind": "ctr"},
+    "shm_fallbacks": {"kind": "ctr"},
+    "shm_slots_inflight": {"kind": "gauge"},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -1141,6 +1153,17 @@ def check_violations(summary: dict[str, Any]) -> list[str]:
             f"spills ({_n(spills)}) did not absorb them: the cold "
             f"store is thrashing; grow cold_tier_capacity or enable "
             f"the disk rung (cold_tier_disk_capacity)")
+    # torn shm slots (ISSUE 18): validation catches them (crc+seq,
+    # never delivered), but ANY tear means a writer died mid-pack or
+    # something scribbled on the segment — one is an incident, a
+    # stream is a crash-looping actor host. Zero is the healthy state.
+    torn = float(ctrs.get("shm_torn_slots", 0.0) or 0.0)
+    if torn > 0:
+        out.append(
+            f"shm_torn_slots: value={_n(torn)} > healthy 0 — torn "
+            f"ring slots were caught (crc/seq mismatch, freed, never "
+            f"delivered) but their writers died mid-pack or the "
+            f"segment was corrupted; check actor-host crash loops")
     # forensics (ISSUE 17): evidence must survive the event it
     # documents. A terminal StallError / quarantine whose run left no
     # black-box dump on disk is silent loss of evidence — the same gap

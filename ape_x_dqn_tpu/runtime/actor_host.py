@@ -89,7 +89,10 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         reconnect_cap_s=getattr(comm, "reconnect_cap_s", 2.0),
         params_push=getattr(comm, "params_push", False),
         serve_policy=(cfg.env.id if serving.multi_tenant else ""),
-        serve_class=serving.default_class)
+        serve_class=serving.default_class,
+        shm=getattr(comm, "shm", False),
+        shm_slots=getattr(comm, "shm_slots", 8),
+        shm_slot_bytes=getattr(comm, "shm_slot_bytes", 1 << 22))
     # the raw socket transport, before any StampingTransport wrap: the
     # serving tier's backpressure callback must reach the object that
     # owns send_experience's drop gate
@@ -321,6 +324,11 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
             "peer_id": peer,
             "telemetry_negotiated": transport.telemetry_negotiated,
             "serve_negotiated": raw_transport.serve_negotiated,
+            "shm_negotiated": raw_transport.shm_negotiated,
+            "shm_posts": raw_transport.shm_posts,
+            "shm_fallbacks": raw_transport.shm_fallbacks,
+            "shm_bytes_out": raw_transport.shm_bytes_out,
+            "shm_param_reads": raw_transport.shm_param_reads,
             "telemetry_frames_out": transport.telemetry_frames_out}
 
 

@@ -137,6 +137,12 @@ class IngestStager:
                 self._ship_buffer()
         self.last_put_decode_ms = put_ms
         self.decode_ms += put_ms
+        # shm slot batches alias a shared-memory ring slot; releasing
+        # after the staging land returns the slot to the actor's
+        # free-list (plain WireBatch/dict have no release — no-op)
+        rel = getattr(batch, "release", None)
+        if rel is not None:
+            rel()
 
     def _ship_buffer(self) -> None:
         """Full buffer -> one add_many dispatch; rotate to the next

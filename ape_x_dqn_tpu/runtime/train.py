@@ -203,7 +203,11 @@ def main(argv: list[str] | None = None) -> int:
         host, port = args.listen.rsplit(":", 1)
         server = transport = SocketIngestServer(
             host, int(port), param_wire_dtype=args.param_wire_dtype,
-            wire_codec=cfg.comm.wire_codec)
+            wire_codec=cfg.comm.wire_codec,
+            shm=getattr(cfg.comm, "shm", False),
+            shm_slots=getattr(cfg.comm, "shm_slots", 8),
+            shm_slot_bytes=getattr(cfg.comm, "shm_slot_bytes", 1 << 22),
+            shm_param_bytes=getattr(cfg.comm, "shm_param_bytes", 1 << 26))
         print(f"ingest listening on {host}:{server.port}",
               file=sys.stderr, flush=True)
     if args.coordinator is not None:

@@ -959,6 +959,246 @@ def bench_wire_ab(args) -> dict:
     return out
 
 
+# -- shared-memory transport lane (comm/shm_transport.py; ISSUE 18) ----------
+
+
+def _shm_artifact_path(smoke: bool) -> str:
+    """Artifact of record for the shm-transport lane. Same smoke/full
+    split as the main bench: a CI smoke run only ever gates against a
+    smoke baseline."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(here, "SHM_SMOKE.json" if smoke
+                        else "SHM_LATEST.json")
+
+
+def _load_shm_baseline(smoke: bool, producers: int, units_per_msg: int
+                       ) -> tuple[str | None, dict | None]:
+    """Newest COMPARABLE shm artifact: same smoke class, same contended
+    producer count, same units/msg. The contended items/s bakes in how
+    many writers fight over the ingest queue and how much each message
+    carries — a cross-shape gate would fire on a shape change, not a
+    regression."""
+    path = _shm_artifact_path(smoke)
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None, None
+    if not (isinstance(doc, dict) and "metric" in doc
+            and "value" in doc):
+        return None, None
+    if (doc.get("producers") != producers
+            or doc.get("units_per_msg") != units_per_msg):
+        log(f"shm gate: {os.path.basename(path)} is "
+            f"{doc.get('producers')}p@{doc.get('units_per_msg')}u, "
+            f"this run is {producers}p@{units_per_msg}u — not "
+            f"comparable, skipped")
+        return None, None
+    return path, doc
+
+
+def bench_shm_ab(args) -> None:
+    """A/B the same-host shared-memory transport (comm/shm_transport)
+    against plain TCP loopback with the default delta-deflate codec,
+    over REAL SocketIngestServer/SocketTransport pairs: ingest items/s
+    shm-on vs shm-off, both orders on fresh pairs, an uncapped arm
+    (one producer) and a contended arm (--shm-ab-producers concurrent
+    producer transports fighting over one ingest queue — the topology
+    the shm plane exists for: N same-host actor processes feeding one
+    learner). Every arm closes its own accounting (offered ==
+    delivered + torn + dropped, zero torn slots delivered) before its
+    number counts. Adoption bar (ISSUE 18): shm >= --shm-ab-bar x TCP
+    items/s on the contended arm in BOTH orders. Writes
+    SHM_LATEST.json (SHM_SMOKE.json under --smoke; PERF.md
+    'Shared-memory transport')."""
+    import threading
+
+    from ape_x_dqn_tpu.comm.socket_transport import (
+        SocketIngestServer, SocketTransport, encode_batch)
+
+    n_wire, f, b = 8, 12, 12
+    n_msgs = 2 if args.smoke else 4
+    # enough replays that the timed window dwarfs the fixed connect +
+    # hello + shm-negotiation cost (~tens of ms); at the measured
+    # per-message costs an arm run is still well under a second
+    iters = 16 if args.smoke else 24  # message-list replays per producer
+    msgs = _wire_ab_messages(n_msgs, n_wire, f, b)
+    # slot must hold one raw-encoded message (shm slots carry raw
+    # payloads — the codec exists to buy bandwidth, and shm has no
+    # wire), plus framing slack
+    slot_bytes = len(encode_batch(msgs[0], "raw")) + 4096
+    producers_contended = max(2, args.shm_ab_producers)
+
+    def arm(shm: bool, producers: int) -> dict:
+        srv = SocketIngestServer(
+            "127.0.0.1", 0, wire_codec="delta-deflate", shm=shm,
+            shm_slots=args.shm_ab_slots, shm_slot_bytes=slot_bytes,
+            shm_param_bytes=1 << 20)
+        trs = [SocketTransport("127.0.0.1", srv.port,
+                               wire_codec="delta-deflate", shm=shm,
+                               shm_slots=args.shm_ab_slots,
+                               shm_slot_bytes=slot_bytes)
+               for _ in range(producers)]
+        dest = {k: np.zeros_like(v) for k, v in msgs[0].items()
+                if isinstance(v, np.ndarray)}
+        offered = producers * len(msgs) * iters
+        got = {"msgs": 0, "units": 0, "t_last": 0.0}
+        sent = threading.Event()
+
+        def consume() -> None:
+            # drain until the producers are done AND the queue is dry;
+            # land through the one-copy staging path so decode cost
+            # (inflate for TCP, memcpy for shm slots) is inside the
+            # measurement, and release each slot back to its ring
+            while True:
+                m = srv.recv_experience(timeout=0.25)
+                if m is None:
+                    if sent.is_set():
+                        return
+                    continue
+                m.decode_into(dest, 0, 0, n_wire)
+                got["msgs"] += 1
+                got["units"] += m.rows
+                got["t_last"] = time.monotonic()
+                rel = getattr(m, "release", None)
+                if rel is not None:
+                    rel()
+
+        def produce(tr: SocketTransport) -> None:
+            for _ in range(iters):
+                for batch in msgs:
+                    tr.send_experience(batch)
+
+        consumer = threading.Thread(target=consume, daemon=True)
+        workers = [threading.Thread(target=produce, args=(tr,),
+                                    daemon=True)
+                   for tr in trs]
+        t0 = time.monotonic()
+        consumer.start()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=120)
+        sent.set()
+        consumer.join(timeout=60)
+        dt = max(got["t_last"] - t0, 1e-9)
+        client_dropped = sum(tr.dropped for tr in trs)
+        posts = sum(tr.shm_posts for tr in trs)
+        falls = sum(tr.shm_fallbacks for tr in trs)
+        out = {
+            "items_per_s": got["units"] * b / dt,
+            "delivered": got["msgs"],
+            "offered": offered,
+            "dropped": srv.dropped + client_dropped,
+            "torn": srv.shm_torn_slots,
+            "shm_posts": posts,
+            "shm_fallbacks": falls,
+            "shm_bytes_in": srv.shm_bytes_in,
+            "negotiated": all(tr.shm_negotiated for tr in trs) if shm
+            else not any(tr.shm_negotiated for tr in trs),
+        }
+        # accounting closure is a hard precondition for the arm's
+        # number to count — a lane that silently lost batches would
+        # report a throughput nobody actually got
+        assert (got["msgs"] + srv.dropped + client_dropped
+                + srv.shm_torn_slots == offered), \
+            f"accounting open: {out}"
+        assert srv.shm_torn_slots == 0, \
+            f"torn slots detected on loopback: {out}"
+        if shm:
+            assert posts + falls + client_dropped == offered, \
+                f"shm post accounting open: {out}"
+            assert srv.shm_doorbells == posts, \
+                f"doorbells {srv.shm_doorbells} != posts {posts}"
+            assert srv.shm_slots_inflight == 0, \
+                f"{srv.shm_slots_inflight} slots still inflight"
+        for tr in trs:
+            tr.close()
+        srv.stop()
+        return out
+
+    pooled: dict[tuple[str, str], list] = {
+        (a, c): [] for a in ("shm", "tcp")
+        for c in ("uncapped", "contended")}
+    out: dict = {"denomination": "frame_ring", "units_per_msg": n_wire,
+                 "transitions_per_unit": b, "n_msgs": n_msgs,
+                 "iters": iters, "slots": args.shm_ab_slots,
+                 "slot_bytes": slot_bytes,
+                 "producers": producers_contended}
+    speedups = {}
+    for order in ("shm_first", "tcp_first"):
+        arms = ("shm", "tcp") if order == "shm_first" \
+            else ("tcp", "shm")
+        runs: dict[tuple[str, str], list] = {
+            k: [] for k in pooled}
+        last: dict[tuple[str, str], dict] = {}
+        for _ in range(args.repeats):
+            for name in arms:
+                for cname, producers in (("uncapped", 1),
+                                         ("contended",
+                                          producers_contended)):
+                    r = arm(name == "shm", producers)
+                    runs[(name, cname)].append(r["items_per_s"])
+                    pooled[(name, cname)].append(r["items_per_s"])
+                    last[(name, cname)] = r
+        out[order] = {
+            f"{name}_{cname}": {
+                "items_per_s": spread(runs[(name, cname)]),
+                "delivered": last[(name, cname)]["delivered"],
+                "offered": last[(name, cname)]["offered"],
+                "dropped": last[(name, cname)]["dropped"],
+                "torn": last[(name, cname)]["torn"],
+            }
+            for (name, cname) in runs}
+        speedups[order] = round(
+            spread(runs[("shm", "contended")])["median"]
+            / spread(runs[("tcp", "contended")])["median"], 2)
+        log(f"shm A/B [{order}]: contended shm "
+            f"{spread(runs[('shm', 'contended')])} vs tcp "
+            f"{spread(runs[('tcp', 'contended')])} items/s -> "
+            f"{speedups[order]}x (uncapped shm "
+            f"{spread(runs[('shm', 'uncapped')])['median']:,.0f} vs "
+            f"tcp {spread(runs[('tcp', 'uncapped')])['median']:,.0f})")
+
+    ok = all(s >= args.shm_ab_bar for s in speedups.values())
+    result = {
+        "metric": "shm_items_per_s_contended",
+        "value": float(f"{spread(pooled[('shm', 'contended')])['median']:.6g}"),
+        "unit": "items/s",
+        "ok": ok,
+        "smoke": bool(args.smoke),
+        "speedup_contended": speedups,
+        "speedup_uncapped": round(
+            spread(pooled[("shm", "uncapped")])["median"]
+            / spread(pooled[("tcp", "uncapped")])["median"], 2),
+        **out,
+    }
+    line = json.dumps(result)
+    gated = getattr(args, "perf_gate", False)
+    rc = 0
+    if gated:
+        args._baseline = _load_shm_baseline(
+            args.smoke, producers_contended, n_wire)
+        rc = _gate_exit(result, args)
+    if not ok:
+        log(f"shm: adoption bar NOT met (contended speedup "
+            f"{speedups} vs >= {args.shm_ab_bar}x in both orders)")
+        rc = rc or 1
+    if rc == 0 or not gated:
+        if ok:
+            path = _shm_artifact_path(args.smoke)
+            try:
+                with open(path, "w") as fh:
+                    fh.write(line + "\n")
+            except OSError as e:
+                log(f"could not write shm artifact {path}: {e!r}")
+    else:
+        log("shm perf-gate: artifact of record NOT updated by this "
+            "failing run")
+    print(line, flush=True)
+    raise SystemExit(rc)
+
+
 # chaos-lane availability recorded before the remediation plane (and
 # the wedged-actor fault) existed: the PERF.md "Chaos lane (round 10)"
 # number the remediation-on arm must hold even with the EXTRA fault in
@@ -3136,6 +3376,34 @@ def main() -> None:
                    help="simulated link MB/s for the capped wire-ab "
                    "arm (default = the round-4 measured live ingest "
                    "rate)")
+    p.add_argument("--shm-ab", action="store_true",
+                   help="run the shared-memory transport A/B INSTEAD "
+                   "of the main bench (comm/shm_transport.py, ISSUE "
+                   "18): ingest items/s with the same-host shm "
+                   "experience ring + doorbell plane vs plain TCP "
+                   "loopback at the default delta-deflate codec, over "
+                   "real server/transport pairs, both orders, "
+                   "median-of-`--repeats` per arm, an uncapped arm "
+                   "(one producer) plus a contended arm "
+                   "(--shm-ab-producers concurrent producers); every "
+                   "arm must close its slot/drop accounting (offered "
+                   "== delivered + torn + dropped, zero torn "
+                   "delivered) before its number counts. Writes "
+                   "SHM_LATEST.json (SHM_SMOKE.json under --smoke; "
+                   "PERF.md 'Shared-memory transport')")
+    p.add_argument("--shm-ab-producers", type=int, default=3,
+                   help="concurrent producer transports in the "
+                   "shm-ab contended arm (the same-host actor-process "
+                   "fan-in the shm plane exists for; >= 2)")
+    p.add_argument("--shm-ab-bar", type=float, default=2.0,
+                   help="adoption bar for the shm lane: shm must "
+                   "reach this multiple of the TCP arm's contended "
+                   "items/s in BOTH orders (2 = the ISSUE 18 "
+                   "acceptance bar)")
+    p.add_argument("--shm-ab-slots", type=int, default=8,
+                   help="experience-ring slots per shm connection in "
+                   "the shm lane (slot bytes are sized to one "
+                   "raw-encoded message automatically)")
     p.add_argument("--chaos-ab", action="store_true",
                    help="run the chaos-lane A/B instead of the main "
                    "bench (same sender fleet through a ChaosProxy, "
@@ -3351,6 +3619,9 @@ def main() -> None:
         return
     if args.serve_ab:
         bench_serve_ab(args)
+        return
+    if args.shm_ab:
+        bench_shm_ab(args)
         return
     log(f"devices: {jax.devices()}")
     if args.prefetch_ab:

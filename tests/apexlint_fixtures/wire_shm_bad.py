@@ -1,0 +1,38 @@
+"""wire-protocol fixture (shm doorbell, broken): the server grants
+shm and validates doorbells, but the client never posts
+MSG_SHM_DOORBELL — a granted ring no doorbell ever names, i.e. the
+half-wired state the checker exists to catch (exactly one finding)."""
+
+MSG_EXPERIENCE = 1
+MSG_HELLO = 2
+MSG_PARAMS = 3
+MSG_SHM_DOORBELL = 4
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_HELLO:
+            return {"shm": self.grant(payload)}
+        if mtype == MSG_EXPERIENCE:
+            return payload
+        if mtype == MSG_PARAMS:
+            return self.params()
+        if mtype == MSG_SHM_DOORBELL:
+            return self.take_slot(payload)
+        return None
+
+    def grant(self, payload):
+        return payload
+
+    def params(self):
+        return None
+
+    def take_slot(self, payload):
+        return payload
+
+
+class Client:
+    def send(self, sock, batch):
+        sock.send(MSG_HELLO)
+        sock.send(MSG_EXPERIENCE)
+        return sock.recv() == MSG_PARAMS

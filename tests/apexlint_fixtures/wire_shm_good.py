@@ -1,0 +1,38 @@
+"""wire-protocol fixture (shm doorbell): MSG_SHM_DOORBELL wired into
+BOTH dispatch chains — the server validates and takes the slot, the
+client posts the doorbell after packing the ring slot."""
+
+MSG_EXPERIENCE = 1
+MSG_HELLO = 2
+MSG_SHM_DOORBELL = 3
+
+
+class Server:
+    def dispatch(self, mtype, payload):
+        if mtype == MSG_HELLO:
+            return {"shm": self.grant(payload)}
+        if mtype == MSG_EXPERIENCE:
+            return payload
+        if mtype == MSG_SHM_DOORBELL:
+            return self.take_slot(payload)
+        return None
+
+    def grant(self, payload):
+        return payload
+
+    def take_slot(self, payload):
+        return payload
+
+
+class Client:
+    def send(self, sock, batch):
+        sock.send(MSG_HELLO)
+        post = self.ring_post(batch)
+        if post is not None:
+            sock.send(MSG_SHM_DOORBELL)
+            return True
+        sock.send(MSG_EXPERIENCE)
+        return False
+
+    def ring_post(self, batch):
+        return batch

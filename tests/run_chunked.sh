@@ -130,6 +130,21 @@ if ! python bench.py --serve-ab --smoke --perf-gate; then
     failed_files+=("bench.py --serve-ab --smoke")
 fi
 
+# Shared-memory transport smoke: the same-host shm ring + doorbell
+# plane vs plain TCP loopback (comm/shm_transport.py, ISSUE 18), both
+# orders, uncapped + contended (3-producer) arms. The lane's own
+# criteria are hard (shm >= 2x TCP contended items/s in BOTH orders,
+# slot/drop accounting closed, zero torn slots delivered), and
+# --perf-gate anti-ratchets contended shm items/s against the last
+# comparable (same producers/units-per-msg/smoke class) SHM_SMOKE.json;
+# failing runs never reseed the baseline.
+echo
+echo "=== bench.py --shm-ab --smoke"
+if ! python bench.py --shm-ab --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --shm-ab --smoke")
+fi
+
 # Flight-recorder smoke: the recorder on/off overhead A/B
 # (obs/blackbox.py) plus the dump round-trip and no-stray-dump
 # checks. The full lane gates the on/off grad-steps/s ratio at the

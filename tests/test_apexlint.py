@@ -292,6 +292,22 @@ def test_wire_protocol_push_fixtures():
     assert "MSG_PARAMS_PUSH" in f.message and "Client" in f.message
 
 
+def test_wire_protocol_shm_fixtures():
+    # ISSUE 18: the doorbell frame rides the SAME dispatch chains as
+    # every other MSG_* — a server that grants rings but a client that
+    # never posts doorbells is the half-wired state the checker exists
+    # to catch
+    good = wire_protocol.check_paths([_fx("wire_shm_good.py")])
+    assert good.findings == []
+    assert good.waivers == 0  # doorbell wired into both chains
+
+    bad = wire_protocol.check_paths([_fx("wire_shm_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "wire-protocol"
+    assert "MSG_SHM_DOORBELL" in f.message and "Client" in f.message
+
+
 def test_retry_annotation_fixtures():
     good = retry_annotation.check_paths(
         [_fx(os.path.join("comm", "retry_good.py"))])

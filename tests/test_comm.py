@@ -206,6 +206,42 @@ def test_wire_codec_shrinks_and_roundtrips():
         np.testing.assert_array_equal(dest[k], batch[k], err_msg=k)
 
 
+def test_decode_leaf_full_copies_are_load_bearing():
+    """The .copy()s in _decode_leaf_full are ownership, not
+    convenience (ISSUE 18 satellite): a materialized leaf must survive
+    its source buffer being scribbled over — a ShmSlotBatch's ring
+    slot is REUSED by the writer the moment release() frees it, and a
+    zlib-inflated codec leaf lives in a per-payload cache the array
+    must outlive — and "xd" leaves need writable memory for the
+    in-place XOR undo. Dropping either copy silently corrupts
+    delivered batches; this pins them."""
+    from ape_x_dqn_tpu.comm.socket_transport import WireBatch
+
+    batch = _codec_batch(seed=11)
+    # raw path: decode from a writable buffer (what a ring slot is),
+    # then scribble over it as a reusing writer would
+    payload = bytearray(encode_batch(batch, "raw"))
+    wb = WireBatch(memoryview(payload))
+    frames = wb["seg_frames"]
+    pris = wb["priorities"]
+    want_f, want_p = batch["seg_frames"].copy(), batch["priorities"].copy()
+    payload[:] = b"\xaa" * len(payload)  # slot reuse
+    np.testing.assert_array_equal(frames, want_f)
+    np.testing.assert_array_equal(pris, want_p)
+    # ownership, not a view into the (now-scribbled) transport buffer
+    assert frames.base is None or frames.flags["OWNDATA"]
+    # codec path: "d"/"xd" leaves must come back writable (the xd
+    # decode XORs rows in place; a frombuffer view of immutable zlib
+    # output would raise) and detached from the decode cache
+    comp = encode_batch(batch, "delta-deflate")
+    wc = WireBatch(comp)
+    arr = wc["seg_frames"]
+    np.testing.assert_array_equal(arr, want_f)
+    assert arr.flags["WRITEABLE"]
+    arr[0, 0, 0, 0] ^= 0xFF  # must not raise, must not poison the cache
+    np.testing.assert_array_equal(wc["action"], batch["action"])
+
+
 def test_wire_codec_interop_matrix():
     """Every (server wire_codec) x (client wire_codec) combination over
     a REAL socket pair delivers bitwise-identical experience, and the

@@ -5,7 +5,12 @@ pull, or torn connection surfaces somewhere an operator can see —
 never a bare `except OSError: pass`. PR 16 extends the same contract
 to `replay/`: the disk spill rung does real file IO off the ingest
 thread, and a swallowed OSError there is a silently lost replay
-segment — exactly the loss class this checker exists to surface. In
+segment — exactly the loss class this checker exists to surface.
+PR 18's `comm/shm_transport.py` sits in the same scope: attaching or
+unlinking a /dev/shm segment is file IO, and a swallowed failure
+there silently downgrades a granted shm connection to TCP — that
+downgrade must be counted (shm_fallbacks) or carry a lossy waiver
+naming why the loss is benign. In
 `comm/`, `runtime/`, and `replay/` modules, any except handler typed
 on a socket-ish/IO error class
 (OSError, ConnectionError and its subclasses, socket.error,
