@@ -28,6 +28,7 @@ _SO = os.path.join(os.path.dirname(_SRC), "libapex_framing.so")
 _lib: ctypes.CDLL | None = None
 _tried = False
 _has_delta = False
+_has_q8 = False
 
 Buffer = bytes | bytearray | memoryview
 
@@ -35,7 +36,7 @@ Buffer = bytes | bytearray | memoryview
 def _load() -> ctypes.CDLL | None:
     # module-level cache: the codec runs per ingest message; don't
     # re-enter build_and_load's lock or rebind argtypes per call
-    global _lib, _tried, _has_delta
+    global _lib, _tried, _has_delta, _has_q8
     if _tried:
         return _lib
     lib = build_and_load(_SRC, _SO)
@@ -73,6 +74,22 @@ def _load() -> ctypes.CDLL | None:
             _has_delta = True
         except AttributeError:
             _has_delta = False
+    if lib is not None:
+        try:
+            # q8 symbols likewise bound separately (param-plane codec,
+            # comm/param_codec.py): a stale .so predating it degrades
+            # only the quantizer to the bit-identical numpy fallback
+            lib.apex_q8_encode.restype = None
+            lib.apex_q8_encode.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_float, ctypes.c_float]
+            lib.apex_q8_dequant_add.restype = None
+            lib.apex_q8_dequant_add.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_float, ctypes.c_float]
+            _has_q8 = True
+        except AttributeError:
+            _has_q8 = False
     _lib, _tried = lib, True
     return _lib
 
@@ -84,6 +101,11 @@ def have_native() -> bool:
 def have_delta_native() -> bool:
     _load()
     return _has_delta
+
+
+def have_q8_native() -> bool:
+    _load()
+    return _has_q8
 
 
 def _addr(data: Buffer) -> tuple[ctypes.c_void_p, int, object]:
@@ -252,3 +274,58 @@ def delta_undo_inplace(rows2d) -> None:
     ptr, _, keep = _addr(memoryview(a).cast("B"))
     lib.apex_delta_undo(ptr, a.shape[0], a.shape[1])
     del keep
+
+
+# -- int8 affine quantization (param codec "delta-q8") ----------------------
+#
+# The numpy fallbacks mirror the C kernels operation-for-operation in
+# strict float32 (np.rint and nearbyintf both round half to even), so a
+# native-enabled learner and a Python-only actor host reconstruct the
+# SAME chain base — cross-impl parity is a wire contract here, pinned
+# by test_param_codec.py.
+
+
+def q8_encode(delta, lo: float, scale: float) -> bytes:
+    """Quantize a C-contiguous float32 array to int8 bins:
+    q = clip(rint((x - lo) / scale) - 127, -128, 127)."""
+    import numpy as np
+
+    a = np.ascontiguousarray(delta, dtype=np.float32).reshape(-1)
+    lib = _load()
+    if lib is None or not _has_q8 or a.size == 0:
+        lo32, scale32 = np.float32(lo), np.float32(scale)
+        q = np.rint((a - lo32) / scale32)
+        return np.clip(q - np.float32(127.0), -128.0,
+                       127.0).astype(np.int8).tobytes()
+    out = np.empty(a.size, dtype=np.int8)
+    dptr, _, dkeep = _addr(memoryview(out).cast("B"))
+    sptr, _, skeep = _addr(memoryview(a).cast("B"))
+    lib.apex_q8_encode(dptr, sptr, a.size,
+                       ctypes.c_float(lo), ctypes.c_float(scale))
+    del dkeep, skeep
+    return out.tobytes()
+
+
+def q8_dequant_add(base, q, lo: float, scale: float) -> None:
+    """Dequantize-and-accumulate IN PLACE into a writable C-contiguous
+    float32 array: base += (q + 127) * scale + lo — the decode side of
+    q8_encode and the encoder's own chain advance."""
+    import numpy as np
+
+    b = base.reshape(-1)
+    qa = np.frombuffer(q, dtype=np.int8) if not isinstance(q, np.ndarray) \
+        else q.reshape(-1)
+    if b.size != qa.size:
+        raise ValueError(f"q8 length mismatch: base {b.size} vs q {qa.size}")
+    lib = _load()
+    if lib is None or not _has_q8 or b.size == 0:
+        lo32, scale32 = np.float32(lo), np.float32(scale)
+        d = (qa.astype(np.float32) + np.float32(127.0)) * scale32
+        d += lo32
+        b += d
+        return
+    bptr, _, bkeep = _addr(memoryview(b).cast("B"))
+    qptr, _, qkeep = _addr(memoryview(qa).cast("B"))
+    lib.apex_q8_dequant_add(bptr, qptr, b.size,
+                            ctypes.c_float(lo), ctypes.c_float(scale))
+    del bkeep, qkeep

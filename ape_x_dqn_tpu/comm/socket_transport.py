@@ -90,6 +90,33 @@ ignores the request payload and replies with the legacy raw pickle;
 an old client sends an empty request and gets exactly that — the
 param path interops both ways with pre-epoch builds.
 
+PARAM CODEC ("delta-q8", CommConfig.param_codec, comm/param_codec.py):
+the cross-host param broadcast — `model_bytes x peers x publish_rate`
+of learner egress — was the last uncompressed high-volume wire path, so
+it now negotiates a delta+quantized codec the way the experience wire
+did in PR 4: params ship as per-leaf int8-quantized deltas against the
+version the peer last received, with per-leaf and whole-payload
+never-inflate guards and automatic full resync when a peer misses a
+version, falls out of the delta window, or crosses an epoch bump. The
+codec is granted per channel: pushes negotiate a "param_codecs" offer /
+"param_codec" grant over the same hello/ack, pulls state a "codec"
+field in the MSG_PARAMS_REQ JSON (the param socket has no hello; an
+old server ignores the unknown key and replies the versioned/legacy
+shape, which the client parses as before). Coded payloads lead with
+their own magic ('APXC'), so every receiver sniffs the right parser —
+old<->new interop degrades silently to the raw paths both ways, the
+shm seqlock area always carries the raw blob (local bandwidth is
+free), and param_codec="raw" keeps the TCP path bitwise identical to
+the pre-codec build. One ParamBlobProvider owns the bytes for every
+(epoch, version) — legacy blob, versioned replies, coded chain, shm
+area and local get_params all read it, so pull and push can never
+disagree about a version's bytes. Fan-out isolation rides the same
+change: the push loop is now a dispatcher that deposits the target
+version into per-subscriber one-deep latest-wins cells drained by
+per-subscriber sender threads — a wedged peer wedges only its own
+thread, and the versions it missed are counted as superseded drops
+(param_push_queue_drops), never queued behind.
+
 SHARED-MEMORY SAME-HOST PLANE (MSG_SHM_DOORBELL, comm/shm_transport.py):
 a client whose hello carries an "shm" offer — boot id plus a namespace
 probe segment the server must attach and read back, so only a true
@@ -131,6 +158,10 @@ from typing import Any
 import numpy as np
 
 from ape_x_dqn_tpu.comm import native, shm_transport
+from ape_x_dqn_tpu.comm.param_codec import (  # noqa: F401 - re-exports
+    _PARAMS_HDR, PARAM_CODECS, PARAMS_CODEC_MAGIC, PARAMS_HDR_MAGIC,
+    ParamBlobProvider, ParamChainDecoder, _Bf16Wire, _downcast_f32,
+    _upcast_bf16, check_param_codec, jax_to_numpy)
 from ape_x_dqn_tpu.obs.health import make_lock
 
 MAGIC = 0x41504558  # 'APEX'
@@ -154,12 +185,11 @@ WIRE_CODECS = ("raw", "delta-deflate")
 _HDR = struct.Struct("<IBIQ")  # magic, type, crc, payload_len
 MAX_PAYLOAD = 1 << 31
 _WARNED_BAD_BLOB = False
-# versioned params reply prefix: magic, membership epoch, version.
-# The magic cannot collide with a legacy reply — raw pickled blobs
-# start with pickle's 0x80 opcode — so a client can parse either shape
-# without knowing the server's build.
-_PARAMS_HDR = struct.Struct("<Iqq")
-PARAMS_HDR_MAGIC = 0x41505856  # 'APXV'
+# _PARAMS_HDR / PARAMS_HDR_MAGIC (the versioned 'APXV' reply prefix)
+# and PARAMS_CODEC_MAGIC (the coded 'APXC' payload prefix) live in
+# comm/param_codec.py with the codec and are re-exported above — the
+# three param payload shapes (legacy pickle 0x80, APXV, APXC) are
+# sniffed by first bytes, none of which collide.
 # samples kept for the reconnect/recovery-latency instrument
 _RECONNECT_SAMPLES = 256
 
@@ -647,6 +677,27 @@ def _recv_msg(sock: socket.socket) -> tuple[int, bytearray] | None:
 # -- learner-host side ------------------------------------------------------
 
 
+class _PushSub:
+    """Per-subscriber push fan-out state. The bounded send queue the
+    drop-to-resync semantics call for is a ONE-DEEP latest-wins target
+    cell: a param subscriber only ever needs the newest version (the
+    codec's chain covers any gap, and a full resync covers the rest),
+    so anything deeper would just delay it — depth-1 with supersede
+    counting IS the bounded queue. `last` is what this subscriber last
+    received (its delta base); sender-thread-private."""
+
+    __slots__ = ("conn", "coded", "wake", "lock", "target", "last", "stop")
+
+    def __init__(self, conn: socket.socket, coded: bool):
+        self.conn = conn
+        self.coded = bool(coded)
+        self.wake = threading.Event()
+        self.lock = make_lock("ingest_server.push_sub")
+        self.target: tuple[int, int] | None = None  # guarded-by: lock
+        self.last: tuple[int, int] = (-1, -1)
+        self.stop = False
+
+
 class SocketIngestServer:
     """Transport implementation that listens for remote actor hosts.
 
@@ -660,6 +711,8 @@ class SocketIngestServer:
                  max_pending: int = 64, idle_grace_s: float = 5.0,
                  param_wire_dtype: str = "bfloat16",
                  wire_codec: str = "delta-deflate",
+                 param_codec: str = "delta-q8",
+                 param_delta_window: int = 8,
                  epoch: int | None = None, shm: bool = False,
                  shm_slots: int = 8, shm_slot_bytes: int = 1 << 22,
                  shm_param_bytes: int = 1 << 26):
@@ -677,6 +730,16 @@ class SocketIngestServer:
         "raw" is the escape hatch that forces every peer to plain
         payloads). Decode is always codec-capable — the setting only
         controls what MSG_HELLO_ACK offers.
+
+        param_codec: param-plane codec this server is willing to grant
+        ("delta-q8" default: per-leaf int8-quantized deltas vs the
+        peer's last-received version, full resync on missed versions /
+        epoch bumps — comm/param_codec.py). Granted only to peers that
+        ASK (hello "param_codecs" offer for pushes, a "codec" field in
+        MSG_PARAMS_REQ for pulls); "raw" keeps the whole param path
+        bitwise identical to the pre-codec build. param_delta_window
+        caps how many encoded delta segments are kept for catch-up — a
+        peer further behind than the window gets a full resync.
 
         epoch: membership epoch id stamped into every MSG_HELLO_ACK
         and versioned params header. Defaults to a wall-clock-derived
@@ -698,6 +761,7 @@ class SocketIngestServer:
                 f"got {param_wire_dtype!r}")
         self._wire_dtype = param_wire_dtype
         self._codec = _check_codec(wire_codec)
+        self._param_codec = check_param_codec(param_codec)
         # membership epoch: wall-clock-derived by default so a restarted
         # incarnation at the same address stamps a DIFFERENT id (tests
         # pin it; collisions need two restarts in the same millisecond)
@@ -712,9 +776,28 @@ class SocketIngestServer:
         self._bytes_in = 0  # guarded-by: _conns_lock
         self._raw_bytes_in = 0  # guarded-by: _conns_lock
         self._bytes_out = 0  # guarded-by: _conns_lock
-        self._params: tuple[Any, int] = (None, -1)  # guarded-by: _lock
-        self._params_blob: bytes | None = pickle.dumps((None, -1))  # guarded-by: _lock
-        self._params_cache: tuple[Any, int] | None = None  # guarded-by: _lock
+        # what the param replies WOULD have cost with no codec — the
+        # numerator of param_compression_ratio (raw-path replies count
+        # their own length, so the ratio is exactly 1.0 under
+        # param_codec="raw" and >= 1.0 under the never-inflate guard)
+        self._param_raw_bytes_out = 0  # guarded-by: _conns_lock
+        # coded peers that held a real base yet needed a full payload
+        # (missed version / out of window / epoch bump)
+        self._param_resyncs = 0  # guarded-by: _conns_lock
+        # push fan-out drops by reason: "superseded" (a deposited
+        # version was overwritten before the subscriber's sender
+        # consumed it — drop-to-resync, never queued behind) and
+        # "disconnect" (send failed, subscriber dropped)
+        self._push_drop_reasons = {"superseded": 0,
+                                   "disconnect": 0}  # guarded-by: _conns_lock
+        # the one versioned-blob provider (comm/param_codec.py): legacy
+        # blob, versioned replies, coded chain, shm area writes and
+        # local get_params all read IT, so pull and push can never
+        # disagree about the bytes for a version (ISSUE 19 small fix —
+        # get_params' cache and the push loop's dedupe previously held
+        # independent state)
+        self._provider = ParamBlobProvider(
+            param_wire_dtype, param_codec, param_delta_window)
         self._lock = make_lock("ingest_server._lock")
         self._stop = threading.Event()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -755,12 +838,16 @@ class SocketIngestServer:
         self._wire_decode_errors = 0  # guarded-by: _conns_lock
         self._last_disconnect: float | None = None  # guarded-by: _conns_lock
         self._ever_connected = False  # guarded-by: _conns_lock
-        # params-push plane: subscribers registered at hello time; a
-        # dedicated thread ships versioned blobs at publish boundaries
-        # so a slow subscriber's sendall never runs on the learner
-        # thread. Per-connection send locks serialize the reader's
-        # replies (acks, poll responses) against push writes.
-        self._push_subs: dict[int, socket.socket] = {}  # guarded-by: _conns_lock
+        # params-push plane: subscribers registered at hello time. A
+        # dispatcher thread (_push_loop) deposits the target
+        # (epoch, version) into each subscriber's one-deep cell at
+        # publish boundaries; PER-SUBSCRIBER sender threads
+        # (_push_sender) build and ship that subscriber's payload — a
+        # slow or wedged peer wedges only its own thread, never the
+        # learner thread and never the other subscribers (ISSUE 19).
+        # Per-connection send locks serialize the reader's replies
+        # (acks, poll responses) against push writes.
+        self._push_subs: dict[int, _PushSub] = {}  # guarded-by: _conns_lock
         self._conn_send_locks: dict[int, Any] = {}  # guarded-by: _conns_lock
         self._param_pushes = 0  # guarded-by: _conns_lock
         self._push_wake = threading.Event()
@@ -815,16 +902,13 @@ class SocketIngestServer:
                     pass
 
     def publish_params(self, params: Any, version: int) -> None:
-        # store the tree and serialize lazily on the first MSG_PARAMS_REQ
+        # store the tree and serialize/encode lazily on the first reply
         # per version: device->host transfer + pickling a multi-MB CNN
         # tree would otherwise run synchronously on the learner thread at
         # every publish boundary, stalling training dispatches — and is
         # pure waste when no remote host is connected
-        with self._lock:
-            self._params = (params, version)
-            self._params_blob = None
-            self._params_cache = None
-        # wake the push thread (no-op when nothing ever subscribed)
+        self._provider.publish(params, version)
+        # wake the push dispatcher (no-op when nothing ever subscribed)
         self._push_wake.set()
 
     def bump_epoch(self) -> None:
@@ -835,24 +919,8 @@ class SocketIngestServer:
         self.epoch += 1
         self._push_wake.set()
 
-    def _build_blob_locked(self) -> bytes:
-        """(Re)build the pickled param blob; caller holds self._lock.
-        Split out of _param_blob so the versioned reply path can read
-        (blob, version) ATOMICALLY — pairing a blob with the version of
-        a concurrent publish would let an up-to-date client skip a real
-        update."""
-        if self._params_blob is None:
-            params, version = self._params
-            host = jax_to_numpy(params)
-            if self._wire_dtype == "bfloat16":
-                host = _downcast_f32(host)
-            self._params_blob = pickle.dumps(  # apexlint: unguarded(caller holds _lock)
-                (host, version), protocol=pickle.HIGHEST_PROTOCOL)
-        return self._params_blob
-
     def _param_blob(self) -> bytes:
-        with self._lock:
-            return self._build_blob_locked()
+        return self._provider.raw_blob()
 
     def _versioned_params_reply(self, have_epoch: int,
                                 have_version: int) -> bytes:
@@ -860,14 +928,9 @@ class SocketIngestServer:
         [magic, epoch, version] header, plus the pickled blob only when
         the client's (epoch, version) is behind — an up-to-date replica
         costs a header-sized reply instead of megabytes of weights."""
-        epoch = self.epoch
-        with self._lock:
-            blob = self._build_blob_locked()
-            version = self._params[1]
-        hdr = _PARAMS_HDR.pack(PARAMS_HDR_MAGIC, epoch, version)
-        if have_epoch == epoch and have_version == version:
-            return hdr
-        return hdr + blob
+        payload, _kind, _ver, _raw = self._provider.versioned_reply(
+            have_epoch, have_version, self.epoch)
+        return payload
 
     def get_params(self) -> tuple[Any, int]:
         """Local loopback callers get the deserialized tree directly,
@@ -875,18 +938,7 @@ class SocketIngestServer:
         the pickled blob stays wire-only. The cache still holds the
         BLOB-roundtripped values (bf16 wire rounding and all), so local
         and remote pulls see bit-identical params."""
-        with self._lock:
-            if self._params_cache is not None:
-                return self._params_cache
-        blob = self._param_blob()
-        params, version = pickle.loads(blob)
-        out = (_upcast_bf16(params), version)
-        with self._lock:
-            # cache only if no newer publish invalidated the blob while
-            # we deserialized outside the lock
-            if self._params_blob is blob:
-                self._params_cache = out
-        return out
+        return self._provider.get_tree()
 
     @property
     def dropped(self) -> int:
@@ -946,6 +998,48 @@ class SocketIngestServer:
         """MSG_PARAMS_PUSH frames shipped to subscribed peers."""
         with self._conns_lock:
             return self._param_pushes
+
+    @property
+    def param_bytes_out(self) -> int:
+        """Param payload bytes served (poll replies + push frames) —
+        the param plane's half of the link budget; bytes_out is its
+        alias on this server (experience flows IN only)."""
+        with self._conns_lock:
+            return self._bytes_out
+
+    @property
+    def param_raw_bytes_out(self) -> int:
+        """What the served param replies would have cost with no codec
+        (the APXV header+blob equivalent of every reply)."""
+        with self._conns_lock:
+            return self._param_raw_bytes_out
+
+    @property
+    def param_compression_ratio(self) -> float:
+        """raw/wire ratio over all param bytes served (exactly 1.0
+        under param_codec="raw"; >= 1.0 always — the never-inflate
+        guard degrades any coded reply that would not undercut the raw
+        one). 0.0 before any param traffic."""
+        with self._conns_lock:
+            return (self._param_raw_bytes_out / self._bytes_out
+                    if self._bytes_out else 0.0)
+
+    @property
+    def param_resyncs(self) -> int:
+        """Full param payloads served to coded peers that held a REAL
+        base (missed version, out of the delta window, epoch bump) —
+        initial fulls to fresh peers don't count."""
+        with self._conns_lock:
+            return self._param_resyncs
+
+    @property
+    def param_push_queue_drops(self) -> dict[str, int]:
+        """Per-reason push fan-out drops: "superseded" (a deposited
+        version was overwritten by a newer one before that subscriber's
+        sender consumed it — the slow peer skips straight to the newest
+        version, by design) and "disconnect" (send failed)."""
+        with self._conns_lock:
+            return dict(self._push_drop_reasons)
 
     @property
     def push_subscribers(self) -> int:
@@ -1070,7 +1164,12 @@ class SocketIngestServer:
 
     def stop(self) -> None:
         self._stop.set()
-        self._push_wake.set()  # unblock the push thread's wait
+        self._push_wake.set()  # unblock the push dispatcher's wait
+        with self._conns_lock:
+            subs = list(self._push_subs.values())
+        for sub in subs:  # unblock every per-subscriber sender
+            sub.stop = True
+            sub.wake.set()
         self._accept_thread.join(timeout=2)
         if self._push_thread is not None:
             self._push_thread.join(timeout=2)
@@ -1137,45 +1236,89 @@ class SocketIngestServer:
             self._push_thread.start()
 
     def _push_loop(self) -> None:
-        """Ship versioned param frames to subscribers at publish/epoch
-        boundaries. Dedupe on (epoch, version) so spurious wakes cost
-        nothing; a subscriber whose send fails is dropped from the set
-        (its reader teardown handles the rest)."""
-        sent: tuple[int, int] | None = None
+        """Push DISPATCHER: at publish/epoch boundaries, write the shm
+        param area (always raw — local bandwidth is free) and deposit
+        the target (epoch, version) into every subscriber's one-deep
+        cell. No socket write happens on this thread anymore — the
+        per-subscriber _push_sender threads own the sendall, so one
+        wedged peer can no longer serialize the broadcast for everyone
+        (the pre-ISSUE-19 loop did exactly that)."""
         while not self._stop.is_set():
             if not self._push_wake.wait(timeout=0.2):
                 continue
             self._push_wake.clear()
-            with self._lock:
-                version = self._params[1]
-                area = self._shm_param_area
+            version = self._provider.version
             cur = (self.epoch, version)
+            with self._lock:
+                area = self._shm_param_area
             # the shm param area rides this thread (same serialization
             # cost, same publish boundary) but dedupes on ITS OWN held
             # (epoch, version): a grant arriving after the last publish
             # must still land current params for the new attacher, even
             # when every TCP subscriber is already up to date
             if area is not None and version >= 0 and area.holds != cur:
-                epoch = self.epoch
-                with self._lock:
-                    blob = self._build_blob_locked()
-                    aver = self._params[1]
-                area.write(blob, epoch, aver)
-            if cur == sent or version < 0:
+                blob, aver, _key = self._provider.raw_blob_versioned()
+                area.write(blob, self.epoch, aver)
+            if version < 0:
                 continue
-            payload = self._versioned_params_reply(-1, -1)
-            sent = cur
             with self._conns_lock:
                 subs = list(self._push_subs.values())
-            for conn in subs:
-                try:
-                    self._send_on(conn, MSG_PARAMS_PUSH, payload)
-                    with self._conns_lock:
-                        self._param_pushes += 1
-                        self._bytes_out += len(payload)
-                except OSError:  # apexlint: lossy(subscriber dropped; reader attributes the disconnect)
-                    with self._conns_lock:
-                        self._push_subs.pop(id(conn), None)
+            for sub in subs:
+                self._deposit(sub, cur)
+
+    def _deposit(self, sub: _PushSub, cur: tuple[int, int]) -> None:
+        """Latest-wins deposit into one subscriber's target cell. An
+        unconsumed DIFFERENT target getting overwritten means the
+        subscriber was still sending (or wedged) when a newer version
+        landed: that stale version is superseded — counted, never
+        queued behind (the codec chain spans the gap; a resync covers
+        the rest)."""
+        with sub.lock:
+            prev, sub.target = sub.target, cur
+        if prev is not None and prev != cur:
+            with self._conns_lock:
+                self._push_drop_reasons["superseded"] += 1
+        sub.wake.set()
+
+    def _push_sender(self, sub: _PushSub) -> None:
+        """One subscriber's sender: consume the latest deposited
+        target, build THIS subscriber's payload — coded subscribers get
+        a delta against what they last received (or a full resync),
+        raw subscribers the versioned header+blob exactly as before —
+        and ship it. Building per subscriber is the price of fan-out
+        isolation; the provider's blob/chain/full caches make every
+        subscriber in the same state share the encode cost."""
+        while not self._stop.is_set() and not sub.stop:
+            if not sub.wake.wait(timeout=0.2):
+                continue
+            sub.wake.clear()
+            with sub.lock:
+                target, sub.target = sub.target, None
+            if target is None or target == sub.last:
+                continue
+            epoch = target[0]
+            had_base = sub.coded and sub.last[1] >= 0
+            try:
+                if sub.coded:
+                    payload, kind, ver, raw_cost = \
+                        self._provider.coded_reply(
+                            sub.last[0], sub.last[1], epoch)
+                else:
+                    payload, kind, ver, raw_cost = \
+                        self._provider.versioned_reply(-1, -1, epoch)
+                self._send_on(sub.conn, MSG_PARAMS_PUSH, payload)
+            except OSError:  # apexlint: lossy(subscriber dropped; reader attributes the disconnect)
+                with self._conns_lock:
+                    self._push_subs.pop(id(sub.conn), None)
+                    self._push_drop_reasons["disconnect"] += 1
+                return
+            sub.last = (epoch, ver)
+            with self._conns_lock:
+                self._param_pushes += 1
+                self._bytes_out += len(payload)
+                self._param_raw_bytes_out += raw_cost
+                if had_base and kind in ("full", "raw_full"):
+                    self._param_resyncs += 1
 
     def _grant_shm(self, conn: socket.socket,
                    req: dict) -> dict[str, Any] | None:
@@ -1321,6 +1464,14 @@ class SocketIngestServer:
                         offered = hello.get("codecs", [])
                         wants_tel = bool(hello.get("telemetry"))
                         wants_push = bool(hello.get("params_push"))
+                        # param-plane codec offer (push channel only —
+                        # pulls negotiate per-request in MSG_PARAMS_REQ
+                        # since the param socket has no hello). Old
+                        # clients never offer; old servers ignore the
+                        # key — raw pushes both ways.
+                        pc_offer = hello.get("param_codecs", [])
+                        if not isinstance(pc_offer, list):
+                            pc_offer = []
                         # serving-tier tenant tag, negotiated like the
                         # telemetry capability: an OLD client never
                         # offers one, an OLD server (this code absent)
@@ -1338,10 +1489,15 @@ class SocketIngestServer:
                             shm_req = req
                     except (ValueError, AttributeError, TypeError):
                         offered, wants_tel, wants_push = [], False, False
+                        pc_offer = []
                         serve_tag = None
                         shm_req = None
                     grant = self._codec if self._codec in offered \
                         else "raw"
+                    pc_grant: str | None = None
+                    if pc_offer:
+                        pc_grant = self._param_codec \
+                            if self._param_codec in pc_offer else "raw"
                     shm_grant = self._grant_shm(conn, shm_req) \
                         if self._shm_enabled and shm_req is not None \
                         else None
@@ -1358,6 +1514,8 @@ class SocketIngestServer:
                     # this socket too would be pure duplicate bytes
                     if wants_push and shm_grant is None:
                         ack["params_push"] = True
+                    if pc_grant is not None:
+                        ack["param_codec"] = pc_grant
                     if shm_grant is not None:
                         ack["shm"] = shm_grant
                     if serve_tag is not None:
@@ -1374,9 +1532,20 @@ class SocketIngestServer:
                     self._send_on(conn, MSG_HELLO_ACK,
                                   json.dumps(ack).encode())
                     if wants_push and shm_grant is None:
+                        sub = _PushSub(
+                            conn, pc_grant not in (None, "raw"))
                         with self._conns_lock:
-                            self._push_subs[id(conn)] = conn
+                            self._push_subs[id(conn)] = sub
+                        threading.Thread(
+                            target=self._push_sender, args=(sub,),
+                            name="params-push-send",
+                            daemon=True).start()
                         self._ensure_push_thread()
+                        # deposit CURRENT params right away: a
+                        # subscriber joining after the last publish
+                        # used to wait for the next one; now its
+                        # sender ships what's already published
+                        self._push_wake.set()
                 elif mtype == MSG_TELEMETRY:
                     # per-peer obs snapshot: remember which peer this
                     # connection is (disconnect attribution), count the
@@ -1397,20 +1566,42 @@ class SocketIngestServer:
                 elif mtype == MSG_PARAMS_REQ:
                     # empty payload = legacy client: raw pickled blob.
                     # JSON payload = epoch-aware client stating what it
-                    # already has: versioned header, blob only if behind.
+                    # already has: versioned header, blob only if
+                    # behind. A "codec" field is the pull channel's
+                    # per-request codec negotiation (the param socket
+                    # has no hello): the coded reply is served iff the
+                    # client asked AND this server's param_codec
+                    # matches — any other combination, including this
+                    # code absent on either side, degrades to the
+                    # versioned/legacy shapes the client already
+                    # parses.
+                    resync = False
                     if len(payload) == 0:
                         reply = self._param_blob()
+                        raw_cost = len(reply)
                     else:
                         try:
                             req = json.loads(bytes(payload))
                             have_ep = int(req.get("epoch", -1))
                             have_v = int(req.get("v", -1))
+                            want = str(req.get("codec", "raw"))
                         except (ValueError, AttributeError, TypeError):
-                            have_ep, have_v = -1, -1
-                        reply = self._versioned_params_reply(
-                            have_ep, have_v)
+                            have_ep, have_v, want = -1, -1, "raw"
+                        if want != "raw" and want == self._param_codec:
+                            reply, kind, _ver, raw_cost = \
+                                self._provider.coded_reply(
+                                    have_ep, have_v, self.epoch)
+                            resync = (have_v >= 0
+                                      and kind in ("full", "raw_full"))
+                        else:
+                            reply, _kind, _ver, raw_cost = \
+                                self._provider.versioned_reply(
+                                    have_ep, have_v, self.epoch)
                     with self._conns_lock:
                         self._bytes_out += len(reply)
+                        self._param_raw_bytes_out += raw_cost
+                        if resync:
+                            self._param_resyncs += 1
                     self._send_on(conn, MSG_PARAMS, reply)
         except OSError:
             # dead connection: drop it, keep serving others — the loss
@@ -1436,7 +1627,12 @@ class SocketIngestServer:
                 except ValueError:
                     pass
                 self._conn_send_locks.pop(id(conn), None)
-                self._push_subs.pop(id(conn), None)
+                sub = self._push_subs.pop(id(conn), None)
+                if sub is not None:
+                    # stop this subscriber's sender thread (it may also
+                    # have exited on its own after a failed send)
+                    sub.stop = True
+                    sub.wake.set()
                 self._conn_serve.pop(id(conn), None)
                 ring = self._conn_shm.pop(id(conn), None)
                 self._last_disconnect = time.monotonic()
@@ -1465,51 +1661,9 @@ class SocketIngestServer:
                 pass
 
 
-def jax_to_numpy(params: Any) -> Any:
-    import jax
-    return jax.tree.map(np.asarray, params) if params is not None else None
-
-
-class _Bf16Wire:
-    """Marker wrapping a leaf the SENDER downcast f32->bf16 for the
-    wire. The receiver upcasts exactly these leaves back to float32 and
-    leaves everything else — including params that are legitimately
-    bfloat16 in the model — untouched, so the wire never silently
-    changes a tree's native dtypes (round-3 advisor finding)."""
-
-    __slots__ = ("a",)
-
-    def __init__(self, a):
-        self.a = a
-
-
-def _downcast_f32(tree: Any) -> Any:
-    """float32 leaves -> bf16 wrapped in _Bf16Wire for the wire (half
-    the bytes; other dtypes — uint8 frames, ints, f64, native bf16 —
-    pass through untouched and untagged)."""
-    import jax
-    import ml_dtypes
-
-    def one(x):
-        x = np.asarray(x)
-        return _Bf16Wire(x.astype(ml_dtypes.bfloat16)) \
-            if x.dtype == np.float32 else x
-
-    return jax.tree.map(one, tree) if tree is not None else None
-
-
-def _upcast_bf16(tree: Any) -> Any:
-    """Restore sender-downcast leaves (_Bf16Wire markers) to float32;
-    every other leaf keeps its wire dtype exactly (values carry the
-    bf16 rounding; exactness is not a wire contract — see
-    SocketIngestServer.param_wire_dtype)."""
-    import jax
-
-    def one(x):
-        return np.asarray(x.a, dtype=np.float32) \
-            if isinstance(x, _Bf16Wire) else x
-
-    return jax.tree.map(one, tree) if tree is not None else None
+# jax_to_numpy / _Bf16Wire / _downcast_f32 / _upcast_bf16 moved to
+# comm/param_codec.py with the param codec (re-exported at the top of
+# this module for existing importers).
 
 
 # -- actor-host side --------------------------------------------------------
@@ -1547,6 +1701,7 @@ class SocketTransport:
                  reconnect_base_s: float = 0.05,
                  reconnect_cap_s: float = 2.0,
                  params_push: bool = False,
+                 param_codec: str = "delta-q8",
                  serve_policy: str = "", serve_class: int = 0,
                  shm: bool = False, shm_slots: int = 8,
                  shm_slot_bytes: int = 1 << 22):
@@ -1565,6 +1720,15 @@ class SocketTransport:
         experience socket and poll_pushed_params() hands them over —
         against an old server the offer is ignored and polling is the
         only path.
+
+        param_codec: param-plane codec to ask for ("delta-q8" default
+        — per-leaf int8-quantized deltas vs the version last received,
+        comm/param_codec.py). Pulls state it per request in
+        MSG_PARAMS_REQ; pushes offer it in the hello. A server that
+        doesn't speak it (old build, or configured raw) replies the
+        versioned/legacy shapes, which parse exactly as before — and
+        param_codec="raw" here keeps the request bytes and the whole
+        TCP param path bitwise identical to the pre-codec build.
 
         serve_policy/serve_class: serving-tier tenant tag offered in
         the hello ("" = untagged, the single-tenant default). A new
@@ -1588,6 +1752,7 @@ class SocketTransport:
         self._hello_timeout = hello_timeout
         self._telemetry = bool(telemetry)
         self._params_push = bool(params_push)
+        self._param_codec = check_param_codec(param_codec)
         self._serve_policy = str(serve_policy)
         self._serve_class = int(serve_class)
         self._reconnect_base_s = max(float(reconnect_base_s), 1e-3)
@@ -1629,6 +1794,17 @@ class SocketTransport:
         self._param_epoch = -1  # guarded-by: _param_lock
         self._param_pull_errors = 0  # guarded-by: _param_lock
         self._param_unchanged = 0  # guarded-by: _param_lock
+        # coded payloads whose base this decoder didn't hold (server
+        # chain window overrun, epoch bump, state lost) — each one
+        # reset the chain and re-pulled full
+        self._param_resyncs = 0  # guarded-by: _param_lock
+        # param-codec chain state: the float32 reconstruction coded
+        # payloads advance. Its own lock because BOTH the pull path and
+        # the push reader thread decode through it.
+        self._param_decoder = ParamChainDecoder()  # guarded-by: _codec_lock
+        # push-channel codec grant from the hello ack (the pull channel
+        # negotiates per request and needs no latch)
+        self._param_codec_ok = False  # guarded-by: _send_lock
         # membership epoch as last seen from any server message; its
         # own lock because both the send path (hello ack) and the param
         # path (versioned replies) update it
@@ -1664,6 +1840,7 @@ class SocketTransport:
         self._param_lock = make_lock("transport._param_lock")
         self._meta_lock = make_lock("transport._meta_lock")
         self._push_lock = make_lock("transport._push_lock")
+        self._codec_lock = make_lock("transport._codec_lock")
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
@@ -1760,6 +1937,7 @@ class SocketTransport:
         self._negotiated = "raw"  # apexlint: unguarded(caller holds _send_lock)
         self._telemetry_ok = False  # apexlint: unguarded(caller holds _send_lock)
         self._push_ok = False  # apexlint: unguarded(caller holds _send_lock)
+        self._param_codec_ok = False  # apexlint: unguarded(caller holds _send_lock)
         self._serve_ok = False  # apexlint: unguarded(caller holds _send_lock)
         # shm attachments belong to the PREVIOUS connection's grant —
         # the server retires those segments on our disconnect, so a
@@ -1781,6 +1959,10 @@ class SocketTransport:
                                          "telemetry": self._telemetry}
                 if self._params_push:
                     offer["params_push"] = True
+                    if self._param_codec != "raw":
+                        # coded pushes ride the same subscription; a
+                        # server without this key's code ignores it
+                        offer["param_codecs"] = [self._param_codec]
                 if self._serve_policy:
                     offer["serve"] = {"policy": self._serve_policy,
                                       "class": self._serve_class}
@@ -1802,6 +1984,10 @@ class SocketTransport:
                         self._telemetry_ok = True  # apexlint: unguarded(caller holds _send_lock)
                     if self._params_push and bool(ack.get("params_push")):
                         self._push_ok = True  # apexlint: unguarded(caller holds _send_lock)
+                    if (self._param_codec != "raw"
+                            and ack.get("param_codec")
+                            == self._param_codec):
+                        self._param_codec_ok = True  # apexlint: unguarded(caller holds _send_lock)
                     if self._serve_policy and bool(ack.get("serve")):
                         self._serve_ok = True  # apexlint: unguarded(caller holds _send_lock)
                     ep = ack.get("epoch")
@@ -1886,10 +2072,17 @@ class SocketTransport:
             parsed = self._parse_params_payload(msg[1])
             if parsed is None:
                 continue
-            _, params, version, ep = parsed
+            status, params, version, ep = parsed
             if ep is None:
                 continue  # push frames are always versioned
             self._note_epoch(ep)
+            if status == "resync":
+                # a pushed delta's base is not what we hold (e.g. a
+                # pull advanced the chain past the push channel's
+                # last-sent): clear the held version so the next pull
+                # asks baseless and comes back full
+                self._note_param_resync()
+                continue
             if params is not None:
                 with self._push_lock:
                     self._pushed = (params, version, ep)
@@ -1899,6 +2092,16 @@ class SocketTransport:
             with self._param_lock:
                 self._param_epoch = ep
                 self._param_version = version
+
+    def _note_param_resync(self) -> None:
+        """A coded payload's base was not what the chain held: count
+        it, drop the chain, and clear the held version so the next
+        request states no base and the server answers full."""
+        with self._codec_lock:
+            self._param_decoder.reset()
+        with self._param_lock:
+            self._param_resyncs += 1
+            self._param_version = -1
 
     def poll_pushed_params(self) -> tuple[Any, int]:
         """Consume the latest server-pushed params, if any arrived
@@ -1913,11 +2116,19 @@ class SocketTransport:
 
     def _parse_params_payload(self, payload) -> \
             tuple[str, Any, int, int | None] | None:
-        """Parse a MSG_PARAMS / MSG_PARAMS_PUSH payload of either
-        shape: ("unchanged"|"full", params, version, epoch|None), or
-        None when the blob is undecodable. A versioned reply leads with
-        PARAMS_HDR_MAGIC; a legacy raw pickle cannot (pickle streams
-        start with the 0x80 opcode), so the sniff is unambiguous."""
+        """Parse a MSG_PARAMS / MSG_PARAMS_PUSH payload of any shape:
+        ("unchanged"|"full"|"resync", params, version, epoch|None), or
+        None when the blob is undecodable. The first bytes name the
+        shape unambiguously: a coded payload leads with
+        PARAMS_CODEC_MAGIC, a versioned reply with PARAMS_HDR_MAGIC,
+        and a legacy raw pickle with neither (pickle streams start with
+        the 0x80 opcode). "resync" means a coded payload's base is not
+        what this decoder holds — the caller clears its held version
+        and re-pulls; params is None."""
+        if len(payload) >= 4:
+            sniff = struct.unpack_from("<I", payload)[0]
+            if sniff == PARAMS_CODEC_MAGIC:
+                return self._parse_coded_payload(payload)
         if len(payload) >= _PARAMS_HDR.size:
             magic, ep, ver = _PARAMS_HDR.unpack_from(payload)
             if magic == PARAMS_HDR_MAGIC:
@@ -1929,13 +2140,35 @@ class SocketTransport:
                 except Exception as e:
                     self._warn_bad_blob(e)
                     return None
-                return "full", _upcast_bf16(params), version, ep
+                tree = _upcast_bf16(params)
+                if self._param_codec != "raw":
+                    # seed the delta chain from this raw-path full, so
+                    # a client bootstrapped over APXV (never-inflate
+                    # degradation, mixed negotiation) rides deltas
+                    # afterwards
+                    with self._codec_lock:
+                        self._param_decoder.note_full(tree, version, ep)
+                return "full", tree, version, ep
         try:
             params, version = pickle.loads(payload)
         except Exception as e:
             self._warn_bad_blob(e)
             return None
         return "full", _upcast_bf16(params), version, None
+
+    def _parse_coded_payload(self, payload) -> \
+            tuple[str, Any, int, int | None] | None:
+        """Apply one coded (PARAMS_CODEC_MAGIC) payload through the
+        chain decoder. A malformed payload warns like a bad blob and
+        returns None; a base mismatch surfaces as "resync"."""
+        try:
+            with self._codec_lock:
+                status, tree, ver, ep = self._param_decoder.apply(
+                    payload)
+        except Exception as e:
+            self._warn_bad_blob(e)
+            return None
+        return status, tree, ver, ep
 
     @staticmethod
     def _warn_bad_blob(e: BaseException) -> None:
@@ -2108,52 +2341,72 @@ class SocketTransport:
             got = self._shm_get_params(reader)
             if got is not None:
                 return got
-        with self._param_lock:
-            req = json.dumps({"v": self._param_version,
-                              "epoch": self._param_epoch}).encode()
-            try:
-                if self._param_sock is None:
-                    self._param_sock = self._connect()
-                _send_msg(self._param_sock, MSG_PARAMS_REQ, req)
-                msg = _recv_msg(self._param_sock)
-                # a corrupt/misframed reply (ValueError from _recv_msg, or
-                # an unexpected type) is treated like a dead connection:
-                # reset the socket and report no params — the caller polls
-                # again. It must never escape into the param-puller thread.
-                if msg is not None and msg[0] != MSG_PARAMS:
-                    raise ValueError(f"unexpected reply type {msg[0]}")
-            except (OSError, ValueError):
-                msg = None  # apexlint: lossy(counted as param_pull_errors just below)
-            if msg is None:
-                self._param_pull_errors += 1
-                if self._param_sock is not None:
-                    try:
-                        self._param_sock.close()
-                    except OSError:  # apexlint: lossy(close of an already-dead socket)
-                        pass
-                self._param_sock = None
-                return None, -1
-            self._bytes_in += len(msg[1])
-        # the blob decode deliberately runs outside _param_lock (it can
-        # be hundreds of ms for a big tree); re-take the lock only for
-        # the state updates
-        parsed = self._parse_params_payload(msg[1])
-        if parsed is None:
+        # two attempts: a "resync" reply (the server's delta chain no
+        # longer reaches our base) clears the held version and retries
+        # immediately — the second request states no base and comes
+        # back full, so one poll cadence never leaves the actor a
+        # version behind over a routine window overrun
+        for attempt in (0, 1):
             with self._param_lock:
-                self._param_pull_errors += 1
-            return None, -1
-        status, params, version, ep = parsed
-        if ep is not None:
-            self._note_epoch(ep)
-        with self._param_lock:
+                req_obj: dict[str, Any] = {"v": self._param_version,
+                                           "epoch": self._param_epoch}
+                if self._param_codec != "raw":
+                    # the pull channel's codec ask; absent under
+                    # param_codec="raw" so the request bytes match the
+                    # pre-codec build exactly
+                    req_obj["codec"] = self._param_codec
+                req = json.dumps(req_obj).encode()
+                try:
+                    if self._param_sock is None:
+                        self._param_sock = self._connect()
+                    _send_msg(self._param_sock, MSG_PARAMS_REQ, req)
+                    msg = _recv_msg(self._param_sock)
+                    # a corrupt/misframed reply (ValueError from
+                    # _recv_msg, or an unexpected type) is treated like
+                    # a dead connection: reset the socket and report no
+                    # params — the caller polls again. It must never
+                    # escape into the param-puller thread.
+                    if msg is not None and msg[0] != MSG_PARAMS:
+                        raise ValueError(
+                            f"unexpected reply type {msg[0]}")
+                except (OSError, ValueError):
+                    msg = None  # apexlint: lossy(counted as param_pull_errors just below)
+                if msg is None:
+                    self._param_pull_errors += 1
+                    if self._param_sock is not None:
+                        try:
+                            self._param_sock.close()
+                        except OSError:  # apexlint: lossy(close of an already-dead socket)
+                            pass
+                    self._param_sock = None
+                    return None, -1
+                self._bytes_in += len(msg[1])
+            # the blob decode deliberately runs outside _param_lock (it
+            # can be hundreds of ms for a big tree); re-take the lock
+            # only for the state updates
+            parsed = self._parse_params_payload(msg[1])
+            if parsed is None:
+                with self._param_lock:
+                    self._param_pull_errors += 1
+                return None, -1
+            status, params, version, ep = parsed
             if ep is not None:
-                self._param_epoch = ep
-                self._param_version = version
+                self._note_epoch(ep)
+            if status == "resync":
+                self._note_param_resync()
+                if attempt == 0:
+                    continue
+                return None, -1
+            with self._param_lock:
+                if ep is not None:
+                    self._param_epoch = ep
+                    self._param_version = version
+                if status == "unchanged":
+                    self._param_unchanged += 1
             if status == "unchanged":
-                self._param_unchanged += 1
-        if status == "unchanged":
-            return None, version
-        return params, version
+                return None, version
+            return params, version
+        return None, -1  # unreachable: the loop returns on attempt 1
 
     def _shm_get_params(self, reader: Any) -> tuple[Any, int] | None:
         """One attempt at a seqlock param read: (params, version) /
@@ -2252,6 +2505,22 @@ class SocketTransport:
         reply (bytes the versioned param path saved shipping)."""
         with self._param_lock:
             return self._param_unchanged
+
+    @property
+    def param_resyncs(self) -> int:
+        """Coded param payloads whose delta base this client no longer
+        held (server chain window overrun, epoch bump) — each one
+        dropped the chain and re-pulled a full."""
+        with self._param_lock:
+            return self._param_resyncs
+
+    @property
+    def param_codec_negotiated(self) -> bool:
+        """True iff the current connection's hello/ack granted the
+        param codec on the PUSH channel (pulls negotiate per request
+        and need no latch; False against an old server or under
+        param_codec="raw")."""
+        return self._param_codec_ok
 
     @property
     def params_push_negotiated(self) -> bool:

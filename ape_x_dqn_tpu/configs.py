@@ -373,6 +373,21 @@ class CommConfig:
     default is on."""
 
     wire_codec: str = "delta-deflate"
+    # Param-plane codec (comm/param_codec.py): "delta-q8" (default)
+    # ships params as per-leaf int8-quantized deltas vs the version the
+    # peer last received, with per-leaf and whole-payload never-inflate
+    # guards and automatic full resync on missed versions / epoch
+    # bumps; "raw" is the escape hatch keeping the TCP param path
+    # bitwise identical to the pre-codec build. Negotiated per channel
+    # (hello offer for pushes, a request field for pulls), so either
+    # peer predating the codec degrades silently to raw. Only the
+    # actor-side policy copy rides this — optimizer state never crosses
+    # this wire (PARITY.md pins the quantized-policy tolerance).
+    param_codec: str = "delta-q8"
+    # How many encoded delta segments the learner keeps for catch-up:
+    # a peer further behind than this many publishes gets a full resync
+    # instead of a delta chain.
+    param_delta_window: int = 8
     # Supervised reconnect (SocketTransport): capped jittered
     # exponential backoff between reconnect attempts after the
     # experience connection fails. The cap MUST stay below the

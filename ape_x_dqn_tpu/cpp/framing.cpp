@@ -10,6 +10,7 @@
 // Build: g++ -O3 -shared -fPIC framing.cpp -o libapex_framing.so
 // (done lazily by ape_x_dqn_tpu/comm/native.py and cached).
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 
@@ -145,6 +146,39 @@ void apex_delta_undo(uint8_t* buf, uint64_t rows, uint64_t row_bytes) {
     for (uint64_t r = 1; r < rows; ++r)
         xor_row(buf + r * row_bytes, buf + r * row_bytes,
                 buf + (r - 1) * row_bytes, row_bytes);
+}
+
+// int8 affine quantization of a float32 param delta (the parameter-
+// plane codec, comm/param_codec.py "delta-q8"): q = clip(rint((x-lo)/
+// scale) - 127, -128, 127). Bit-parity with the numpy fallback is a
+// wire contract — both sides of a delta chain must reconstruct the
+// SAME float32 base or the drift outlives the quantization bound — so
+// every operation stays strict single-precision in the same order as
+// the numpy expression, and rounding is nearbyintf under the default
+// round-to-nearest-even mode (== np.rint).
+void apex_q8_encode(int8_t* dst, const float* src, uint64_t n,
+                    float lo, float scale) {
+    if (!dst || !src || scale == 0.0f) return;
+    for (uint64_t i = 0; i < n; ++i) {
+        float r = nearbyintf((src[i] - lo) / scale) - 127.0f;
+        if (r < -128.0f) r = -128.0f;
+        if (r > 127.0f) r = 127.0f;
+        dst[i] = (int8_t)r;
+    }
+}
+
+// Dequantize-and-accumulate: base[i] += (q[i] + 127) * scale + lo —
+// the decode side of apex_q8_encode AND the encoder's own chain
+// advance (the encoder reconstructs exactly what decoders will hold,
+// so quantization error never compounds across versions). Same strict
+// f32 op order as the numpy fallback.
+void apex_q8_dequant_add(float* base, const int8_t* q, uint64_t n,
+                         float lo, float scale) {
+    if (!base || !q) return;
+    for (uint64_t i = 0; i < n; ++i) {
+        float d = ((float)q[i] + 127.0f) * scale;
+        base[i] += d + lo;
+    }
 }
 
 }  // extern "C"

@@ -280,6 +280,25 @@ INSTRUMENTS = {
     "shm_torn_slots": {"kind": "ctr"},
     "shm_fallbacks": {"kind": "ctr"},
     "shm_slots_inflight": {"kind": "gauge"},
+    # param-plane codec (comm/param_codec.py, ISSUE 19): weight
+    # broadcast over TCP as quantized deltas against each subscriber's
+    # acked version. bytes_out is the actual wire spend; the ratio is
+    # raw-equivalent/wire (cumulative); resyncs count full-blob
+    # fallbacks (missed version, epoch bump, window overrun); queue
+    # drops count per-subscriber latest-wins supersedes — a steady
+    # stream on one peer is a slow subscriber riding resyncs, not a
+    # broadcast stall (README "Parameter-plane codec").
+    "param_bytes_out": {"kind": "ctr"},
+    "param_resyncs": {"kind": "ctr"},
+    "param_push_queue_drops": {"kind": "ctr"},
+    "param_compression_ratio": {
+        "kind": "gauge",
+        "warn": ("value_min", 1.0,
+                 "param compression ratio below 1.0 should be "
+                 "impossible (the codec never-inflates: every delta "
+                 "segment and full blob is capped at the raw "
+                 "versioned-blob cost) — a reading here means the "
+                 "per-leaf or blob-level guard is broken")},
 }
 
 # healthy ranges, derived view kept under its historical name (the
@@ -896,6 +915,32 @@ def _fmt_cold(summary: dict[str, Any]) -> list[str]:
     return lines
 
 
+def _fmt_params(summary: dict[str, Any]) -> list[str]:
+    """Param-plane codec section (comm/param_codec.py): wire spend vs
+    raw-equivalent cost for the weight broadcast, resync and
+    queue-drop counters. The ratio is cumulative over the run; 1.0
+    means every peer negotiated raw (old build or
+    comm.param_codec=raw)."""
+    gauges = summary.get("gauges", {})
+    ctrs = summary.get("ctrs", {})
+    ratio = gauges.get("param_compression_ratio")
+    if ratio is None and "param_bytes_out" not in ctrs:
+        return []
+    lines = ["param plane (delta+quantized weight broadcast):"]
+    lines.append(
+        f"  wire bytes out={_n(ctrs.get('param_bytes_out'))} "
+        f"compression={_n(ratio)}x raw-equivalent/wire")
+    lines.append(
+        f"  resyncs={int(ctrs.get('param_resyncs', 0))} "
+        f"queue_drops={int(ctrs.get('param_push_queue_drops', 0))}")
+    if ratio is not None and float(ratio) < 1.5:
+        lines.append("    ⚠ param ratio <1.5x: peers negotiated raw "
+                     "(old build / comm.param_codec=raw) or every "
+                     "publish forced a full resync — the weight "
+                     "broadcast runs (near-)uncompressed")
+    return lines
+
+
 def _fmt_remediation(summary: dict[str, Any]) -> list[str]:
     """Remediation-plane section (runtime/remediation.py): the policy
     engine's decisions grouped by rule/target/action/outcome, the
@@ -1046,6 +1091,10 @@ def format_report(summary: dict[str, Any]) -> str:
     if cold_lines:
         lines.append("")
         lines.extend(cold_lines)
+    param_lines = _fmt_params(summary)
+    if param_lines:
+        lines.append("")
+        lines.extend(param_lines)
     peer_lines = _fmt_peers(summary)
     if peer_lines:
         lines.append("")

@@ -88,6 +88,7 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
         reconnect_base_s=getattr(comm, "reconnect_base_s", 0.05),
         reconnect_cap_s=getattr(comm, "reconnect_cap_s", 2.0),
         params_push=getattr(comm, "params_push", False),
+        param_codec=getattr(comm, "param_codec", "delta-q8"),
         serve_policy=(cfg.env.id if serving.multi_tenant else ""),
         serve_class=serving.default_class,
         shm=getattr(comm, "shm", False),
@@ -314,6 +315,8 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
             "epoch_changes": transport.epoch_changes,
             "param_pull_errors": transport.param_pull_errors,
             "param_pushes_in": transport.param_pushes_in,
+            "param_codec_negotiated": transport.param_codec_negotiated,
+            "param_resyncs": transport.param_resyncs,
             "bytes_out": transport.bytes_out,
             "wire_codec": transport.negotiated_codec,
             "wire_compression_ratio": round(

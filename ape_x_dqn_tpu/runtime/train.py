@@ -204,6 +204,8 @@ def main(argv: list[str] | None = None) -> int:
         server = transport = SocketIngestServer(
             host, int(port), param_wire_dtype=args.param_wire_dtype,
             wire_codec=cfg.comm.wire_codec,
+            param_codec=getattr(cfg.comm, "param_codec", "delta-q8"),
+            param_delta_window=getattr(cfg.comm, "param_delta_window", 8),
             shm=getattr(cfg.comm, "shm", False),
             shm_slots=getattr(cfg.comm, "shm_slots", 8),
             shm_slot_bytes=getattr(cfg.comm, "shm_slot_bytes", 1 << 22),

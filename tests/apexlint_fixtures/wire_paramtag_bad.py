@@ -1,0 +1,22 @@
+"""wire-protocol fixture: the publisher grew a coded 'APXC' payload
+shape but the parser still sniffs only 'APXV' — the half-wired state
+that stalls exactly the peers that negotiated the codec. The tags are
+IMPORTED (as in the real split: tags live in param_codec.py, the
+client parser in socket_transport.py), calibrating that imported tag
+names count toward the module's family."""
+
+from param_codec import PARAMS_CODEC_MAGIC, PARAMS_HDR_MAGIC  # noqa: F401
+
+
+class Publisher:
+    def reply(self, coded, blob):
+        if coded:
+            return (PARAMS_CODEC_MAGIC, blob)
+        return (PARAMS_HDR_MAGIC, blob)
+
+
+class Parser:
+    def parse(self, magic, payload):
+        if magic == PARAMS_HDR_MAGIC:
+            return self.parse_versioned(payload)
+        return self.parse_legacy(payload)

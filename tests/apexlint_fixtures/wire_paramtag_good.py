@@ -1,0 +1,22 @@
+"""wire-protocol fixture: both param payload tags fully wired — the
+publisher ships raw 'APXV' and coded 'APXC' bodies, and the parser
+sniffs both before falling back to the legacy pickle shape."""
+
+PARAMS_HDR_MAGIC = 0x41505856
+PARAMS_CODEC_MAGIC = 0x41505843
+
+
+class Publisher:
+    def reply(self, coded, blob):
+        if coded:
+            return (PARAMS_CODEC_MAGIC, blob)
+        return (PARAMS_HDR_MAGIC, blob)
+
+
+class Parser:
+    def parse(self, magic, payload):
+        if magic == PARAMS_CODEC_MAGIC:
+            return self.apply_coded(payload)
+        if magic == PARAMS_HDR_MAGIC:
+            return self.parse_versioned(payload)
+        return self.parse_legacy(payload)

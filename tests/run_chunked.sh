@@ -145,6 +145,21 @@ if ! python bench.py --shm-ab --smoke --perf-gate; then
     failed_files+=("bench.py --shm-ab --smoke")
 fi
 
+# Param-plane codec smoke: delta-q8 vs raw weight broadcast to real
+# push subscribers (comm/param_codec.py, ISSUE 19), both orders, plus
+# the capped-link run, the quantized-policy greedy-parity smoke and
+# the slow-subscriber isolation arm. The lane's own criteria are hard
+# (>= 3x bytes/publish cut in BOTH orders, parity >= 0.99, healthy
+# peers unmoved by a wedged one), and --perf-gate anti-ratchets the
+# reduction against the last comparable (same subs/param-count/smoke
+# class) PARAMS_SMOKE.json; failing runs never reseed the baseline.
+echo
+echo "=== bench.py --params-ab --smoke"
+if ! python bench.py --params-ab --smoke --perf-gate; then
+    fail=1
+    failed_files+=("bench.py --params-ab --smoke")
+fi
+
 # Flight-recorder smoke: the recorder on/off overhead A/B
 # (obs/blackbox.py) plus the dump round-trip and no-stray-dump
 # checks. The full lane gates the on/off grad-steps/s ratio at the

@@ -308,6 +308,26 @@ def test_wire_protocol_shm_fixtures():
     assert "MSG_SHM_DOORBELL" in f.message and "Client" in f.message
 
 
+def test_wire_protocol_paramtag_fixtures():
+    # ISSUE 19: the param payload TAG ('APXV' raw-versioned vs 'APXC'
+    # delta-coded) is a protocol family one level below MSG_* — a
+    # parser sniffing one tag while the publisher ships both stalls
+    # exactly the peers that negotiated the codec. The bad fixture
+    # also IMPORTS its tags (the real split: tags in param_codec.py,
+    # parser in socket_transport.py), so it calibrates that imported
+    # names count toward the module's tag family.
+    good = wire_protocol.check_paths([_fx("wire_paramtag_good.py")])
+    assert good.findings == []
+    assert good.waivers == 0  # both tags routed, nothing to excuse
+
+    bad = wire_protocol.check_paths([_fx("wire_paramtag_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "wire-protocol"
+    assert "PARAMS_CODEC_MAGIC" in f.message and "Parser" in f.message
+    assert "payload-tag" in f.message
+
+
 def test_retry_annotation_fixtures():
     good = retry_annotation.check_paths(
         [_fx(os.path.join("comm", "retry_good.py"))])
@@ -527,6 +547,41 @@ def test_config_coverage_serving_scope(tmp_path):
     assert any("serving.imaginary_knob" in m for m in msgs)
     assert any("ServingConfig.dead_knob" in m for m in msgs)
     assert not any("multi_tenant" in m for m in msgs)
+    assert len(res.findings) == 2
+
+
+def test_config_coverage_param_codec_scope(tmp_path):
+    """ISSUE 19 knobs stay in scope: `comm.param_codec` read through
+    getattr counts as a read (train.py reads the codec knobs exactly
+    that way, for configs checkpointed before the field existed), a
+    dead param_* knob still flags, and a README naming a nonexistent
+    comm.param_* knob flags the phantom direction."""
+    from tools.apexlint import config_coverage
+
+    configs = tmp_path / "configs.py"
+    configs.write_text(
+        "from dataclasses import dataclass\n"
+        "@dataclass\nclass CommConfig:\n"
+        "    param_codec: str = 'delta-q8'\n"
+        "    param_delta_window: int = 8\n"
+        "    param_dead_knob: int = 0\n")
+    reader = tmp_path / "reader.py"
+    reader.write_text(
+        "def f(cfg):\n"
+        "    codec = getattr(cfg, 'param_codec', 'raw')\n"
+        "    return codec, cfg.param_delta_window\n")
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "set comm.param_codec and comm.param_delta_window, "
+        "not comm.param_phantom_knob\n")
+    res = config_coverage.check(
+        [str(configs), str(reader)], configs_path=str(configs),
+        readme_path=str(readme))
+    msgs = [f.message for f in res.findings]
+    assert any("comm.param_phantom_knob" in m for m in msgs)
+    assert any("CommConfig.param_dead_knob" in m for m in msgs)
+    assert not any("param_codec" in m or "param_delta_window" in m
+                   for m in msgs)
     assert len(res.findings) == 2
 
 

@@ -12,6 +12,22 @@ explicit module-level waiver:
 so a new message type added to one end cannot ship half-wired (the
 PR-4 codec negotiation added MSG_EXPERIENCE_C to both ends by hand;
 this makes the next one a lint failure instead of a runtime stall).
+
+ISSUE 19 adds a second protocol family one level down: the param
+payload TAG. A MSG_PARAMS/MSG_PARAMS_PUSH body is sniffed by its
+leading magic (`PARAMS_HDR_MAGIC` 'APXV' raw-versioned vs
+`PARAMS_CODEC_MAGIC` 'APXC' delta-coded), so a parser that dispatches
+on one tag but not the other is exactly the half-wired state the
+MSG_* rule exists to catch — except it stalls only for peers that
+negotiated the missing shape. Any class that references ONE
+`PARAMS_*MAGIC` tag (threshold 1, not 3: the family is two members
+and a single-tag parser IS the bug) must reference every tag declared
+OR imported in its module, or waive it the same way:
+
+    # apexlint: unhandled(PARAMS_HDR_MAGIC)
+
+Imported tags count because the tags live in param_codec.py while the
+client parser dispatching on them lives in socket_transport.py.
 """
 
 from __future__ import annotations
@@ -24,7 +40,9 @@ from tools.apexlint.common import CheckResult, Finding, ModuleSource
 CHECKER = "wire-protocol"
 
 MSG_NAME_RE = re.compile(r"^MSG_[A-Z0-9_]+$")
+TAG_NAME_RE = re.compile(r"^PARAMS_[A-Z0-9_]*MAGIC$")
 DISPATCH_MIN_REFS = 3
+TAG_MIN_REFS = 1
 
 
 def _module_constants(tree: ast.Module) -> dict[str, int]:
@@ -40,6 +58,23 @@ def _module_constants(tree: ast.Module) -> dict[str, int]:
     return consts
 
 
+def _module_tags(tree: ast.Module) -> set[str]:
+    """Param payload-tag names assigned OR imported at module level."""
+    tags: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (isinstance(target, ast.Name)
+                        and TAG_NAME_RE.match(target.id)):
+                    tags.add(target.id)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if TAG_NAME_RE.match(name):
+                    tags.add(name)
+    return tags
+
+
 def _class_refs(cls: ast.ClassDef, names: set[str]) -> set[str]:
     refs: set[str] = set()
     for node in ast.walk(cls):
@@ -50,28 +85,35 @@ def _class_refs(cls: ast.ClassDef, names: set[str]) -> set[str]:
 
 def check_module(src: ModuleSource) -> CheckResult:
     result = CheckResult()
-    consts = _module_constants(src.tree)
-    if not consts:
-        return result
-    names = set(consts)
     waived = {arg.strip() for arg in
               src.waivers_of_kind("unhandled").values()}
-    chains = []
-    for node in src.tree.body:
-        if isinstance(node, ast.ClassDef):
-            refs = _class_refs(node, names)
-            if len(refs) >= DISPATCH_MIN_REFS:
-                chains.append((node, refs))
-    for cls, refs in chains:
-        for name in sorted(names - refs):
-            if name in waived:
-                result.waivers += 1
-                continue
-            result.findings.append(Finding(
-                CHECKER, src.path, cls.lineno,
-                f"{name} is not handled in dispatch chain "
-                f"{cls.name!r} (reference it or waive with "
-                f"`# apexlint: unhandled({name})`)"))
+    families = []
+    consts = _module_constants(src.tree)
+    if consts:
+        families.append((set(consts), DISPATCH_MIN_REFS,
+                         "dispatch chain"))
+    tags = _module_tags(src.tree)
+    if len(tags) > 1:
+        # a module holding a single tag name has nothing to dispatch
+        # between; the family check starts when a second shape exists
+        families.append((tags, TAG_MIN_REFS, "payload-tag parser"))
+    for names, min_refs, kind in families:
+        chains = []
+        for node in src.tree.body:
+            if isinstance(node, ast.ClassDef):
+                refs = _class_refs(node, names)
+                if len(refs) >= min_refs:
+                    chains.append((node, refs))
+        for cls, refs in chains:
+            for name in sorted(names - refs):
+                if name in waived:
+                    result.waivers += 1
+                    continue
+                result.findings.append(Finding(
+                    CHECKER, src.path, cls.lineno,
+                    f"{name} is not handled in {kind} "
+                    f"{cls.name!r} (reference it or waive with "
+                    f"`# apexlint: unhandled({name})`)"))
     return result
 
 
