@@ -199,6 +199,7 @@ class ShmRingServer:
         self.slot_bytes = int(slot_bytes)
         size = _RING_HDR.size + self.slots \
             + self.slots * (_SLOT_HDR.size + self.slot_bytes)
+        # apexlint: releases(_seg, unlink<close)
         self._seg = shared_memory.SharedMemory(create=True, size=size)
         buf = self._seg.buf
         _RING_HDR.pack_into(buf, 0, RING_MAGIC, self.slots,
@@ -395,6 +396,7 @@ class ShmParamArea:
 
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
+        # apexlint: releases(_seg, unlink<close)
         self._seg = shared_memory.SharedMemory(
             create=True, size=_PAR_HDR_SIZE + self.capacity)
         buf = self._seg.buf

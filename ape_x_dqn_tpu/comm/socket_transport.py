@@ -1181,6 +1181,18 @@ class SocketIngestServer:
             except OSError:  # apexlint: lossy(shutdown close best effort)
                 pass
         self._listener.close()
+        # drain the ingest queue: a batch parked at shutdown is never
+        # consumed, and a parked ShmSlotBatch pins its ring slot (and
+        # with it the mapping) until released — drain BEFORE destroying
+        # the rings so every slot is handed back first
+        while True:
+            try:
+                old = self._q.get_nowait()
+            except queue.Empty:
+                break
+            rel = getattr(old, "release", None)
+            if rel is not None:
+                rel()
         # shm teardown: the server owns every segment it granted
         with self._conns_lock:
             rings = list(self._conn_shm.values())
@@ -1207,6 +1219,7 @@ class SocketIngestServer:
                 self._conns.append(conn)
                 self._conn_send_locks[id(conn)] = make_lock(
                     "ingest_server.conn_send")
+            # apexlint: detached(reader exits when its socket dies; stop() closes every conn)
             threading.Thread(target=self._reader, args=(conn,),
                              name="ingest-reader", daemon=True).start()
 
@@ -1536,6 +1549,7 @@ class SocketIngestServer:
                             conn, pc_grant not in (None, "raw"))
                         with self._conns_lock:
                             self._push_subs[id(conn)] = sub
+                        # apexlint: detached(per-subscriber sender exits on sub.stop, set by stop() and by disconnect)
                         threading.Thread(
                             target=self._push_sender, args=(sub,),
                             name="params-push-send",
@@ -1774,6 +1788,10 @@ class SocketTransport:
         self._telemetry_bytes_out = 0  # guarded-by: _send_lock
         self._sock: socket.socket | None = None  # guarded-by: _send_lock
         self._param_sock: socket.socket | None = None  # guarded-by: _param_lock
+        # every client-side drop is attributed to exactly one reason
+        # bucket — the fleet report's drop_reasons table sums to
+        # `dropped` because lint proves it, not because tests noticed
+        # apexlint: closure(_dropped == _drop_reasons)
         self._dropped = 0  # guarded-by: _send_lock
         self._bytes_out = 0  # guarded-by: _send_lock
         self._raw_bytes_out = 0  # guarded-by: _send_lock
@@ -2012,6 +2030,7 @@ class SocketTransport:
                         pass
         self._note_connected()
         if self._push_ok:
+            # apexlint: detached(push reader dies with its socket; close() and reconnect both close it)
             threading.Thread(target=self._push_reader, args=(sock,),
                              name="params-push-reader",
                              daemon=True).start()

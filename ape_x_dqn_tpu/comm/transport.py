@@ -85,6 +85,17 @@ class LoopbackTransport:
     def pending(self) -> int:
         return self._q.qsize()
 
+    def close(self) -> None:
+        """Drain parked batches so their (potentially large) arrays
+        are not pinned by a queue nobody will read again — loopback
+        holds no OS handles, but drivers call close() on every
+        transport symmetrically."""
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
     # parameter path (learner -> actors/server)
 
     def publish_params(self, params: Any, version: int) -> None:

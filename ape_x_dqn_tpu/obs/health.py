@@ -322,6 +322,8 @@ class StallWatchdog:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
 
     def _watch(self) -> None:
         import jax  # deferred: report/offline tools import this module

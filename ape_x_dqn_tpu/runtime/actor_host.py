@@ -246,6 +246,7 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     # that one needs a reply from the new incarnation to arrive; this
     # one covers a latch desynced by a controller that went silent.
     rcfg = getattr(cfg, "remediation", None)
+    bp_thread: threading.Thread | None = None
     if (rcfg is not None and rcfg.mode == "enforce"
             and serving.multi_tenant and serving.backpressure):
         def bp_watchdog() -> None:
@@ -264,8 +265,9 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
                     obs.count("remediation_actions")
                     stale_since = None
 
-        threading.Thread(target=bp_watchdog, name="remediation-bp",
-                         daemon=True).start()
+        bp_thread = threading.Thread(target=bp_watchdog,
+                                     name="remediation-bp", daemon=True)
+        bp_thread.start()
 
     per_actor = frames_per_actor or (
         cfg.total_env_frames // max(cfg.actors.num_actors, 1))
@@ -299,9 +301,14 @@ def run_actor_host(cfg: RunConfig, host: str, port: int,
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        # bounded join in a liveness loop: actors run to frame budget,
+        # but a wedged worker must not wedge teardown unobservably
+        while t.is_alive():
+            t.join(timeout=5.0)
     stop_event.set()
     puller.join(timeout=2)
+    if bp_thread is not None:
+        bp_thread.join(timeout=2)
     server.stop()
     if emitter is not None:
         emitter.stop()  # ships one shutdown-fresh frame

@@ -362,6 +362,7 @@ class ApexDriver:
         # already-stored segments are the store's own counter).
         self._cold: ColdStore | None = None
         self._disk = None        # disk-spill rung (replay/disk_store.py)
+        # apexlint: closure(_cold_evicted == _cold_stored + _cold_dropped)
         self._cold_evicted = 0   # ingest thread only
         self._cold_stored = 0    # ingest thread only
         self._cold_dropped = 0   # ingest thread only
@@ -370,6 +371,7 @@ class ApexDriver:
         # eviction swap runs per shard, so the closure holds per shard:
         # evicted[d] == stored[d] + dropped[d] — the PR-9
         # ingest_dropped_per_shard idiom extended to the cold door)
+        # apexlint: closure(_cold_evicted_per_shard == _cold_stored_per_shard + _cold_dropped_per_shard)
         self._cold_evicted_per_shard = np.zeros(self.dp, np.int64)
         self._cold_stored_per_shard = np.zeros(self.dp, np.int64)
         self._cold_dropped_per_shard = np.zeros(self.dp, np.int64)

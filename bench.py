@@ -4226,9 +4226,13 @@ def main() -> None:
         lint = apexlint_run(
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          "ape_x_dqn_tpu"))
+        # per_checker rows carry {findings, waivers, ms} — v3's
+        # lifecycle/closure checkers and their timings ride along;
+        # `closures` counts the statically-verified conservation laws
         secondary["apexlint"] = {"findings": len(lint["findings"]),
                                  "waivers": lint["waivers"],
-                                 "per_checker": lint["per_checker"]}
+                                 "per_checker": lint["per_checker"],
+                                 "closures": len(lint["closures"])}
     except Exception as e:  # lint must never sink a bench run
         secondary["apexlint"] = {"error": repr(e)}
 

@@ -1,0 +1,45 @@
+"""counter-closure calibration: the compliant shapes.
+
+Every `_evicted` bump is post-dominated by exactly one term bump —
+through if/else branches, loop bodies, and an error path that
+attributes its drop. One bump sits outside the law on purpose and
+carries the waiver.
+"""
+
+
+class GoodLedger:
+    # apexlint: closure(_evicted == _stored + _dropped)
+    def __init__(self):
+        self._evicted = 0
+        self._stored = 0
+        self._dropped = 0
+
+    def ship(self, items):
+        for ok in items:
+            self._evicted += 1
+            if ok:
+                self._stored += 1
+            else:
+                self._dropped += 1
+
+    def bulk(self, n, ok):
+        self._evicted += n
+        if ok:
+            self._stored += n
+            return True
+        self._dropped += n
+        return False
+
+    def ship_fallible(self, batch):
+        self._evicted += 1
+        try:
+            self._store(batch)
+            self._stored += 1
+        except OSError:
+            self._dropped += 1
+
+    def rebalance(self):
+        self._evicted += 1  # apexlint: closure(rebalance move, not a door outcome)
+
+    def _store(self, batch):
+        raise OSError

@@ -1,0 +1,25 @@
+"""counter-closure calibration: the closure-leaking-path case.
+
+The happy path bumps _stored, but the error path returns without a
+_dropped bump — the declared law leaks on exactly that path. Exactly
+one finding, at the _evicted bump line.
+"""
+
+
+class LeakyLedger:
+    # apexlint: closure(_evicted == _stored + _dropped)
+    def __init__(self):
+        self._evicted = 0
+        self._stored = 0
+        self._dropped = 0
+
+    def ship(self, batch):
+        self._evicted += 1
+        try:
+            self._store(batch)
+            self._stored += 1
+        except OSError:
+            return
+
+    def _store(self, batch):
+        raise OSError

@@ -1,0 +1,32 @@
+"""resource-lifecycle calibration: the compliant shapes.
+
+An shm segment with a declared (and honored) unlink<close ordering, a
+bounded queue drained on close, a file handle closed on close, and one
+socket whose teardown is deliberately the caller's (waived).
+"""
+
+import queue
+import socket
+from multiprocessing import shared_memory
+
+
+class GoodArea:
+    def __init__(self, path):
+        # apexlint: releases(_seg, unlink<close)
+        self._seg = shared_memory.SharedMemory(create=True, size=64)
+        self._q = queue.Queue(maxsize=8)
+        self._fh = open(path, "a")
+
+    def close(self):
+        try:
+            self._seg.unlink()
+        finally:
+            self._seg.close()
+        while not self._q.empty():
+            self._q.get_nowait()
+        self._fh.close()
+
+
+class SocketLender:
+    def __init__(self, addr):
+        self._sock = socket.create_connection(addr)  # apexlint: releases(caller owns teardown)

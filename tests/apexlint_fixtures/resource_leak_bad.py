@@ -1,0 +1,13 @@
+"""resource-lifecycle calibration: the leak-by-construction case.
+
+A file handle held on self with no teardown method anywhere on the
+class. Exactly one finding, at the acquire line.
+"""
+
+
+class LeakyHolder:
+    def __init__(self, path):
+        self._fh = open(path, "a")
+
+    def write(self, line):
+        self._fh.write(line)

@@ -1,0 +1,18 @@
+"""resource-lifecycle calibration: the unlink-after-close case.
+
+The acquire declares releases(_seg, unlink<close), but destroy()
+closes first — exactly the PR 18 close-pins-mapping bug. Exactly one
+finding, at the acquire line.
+"""
+
+from multiprocessing import shared_memory
+
+
+class ClosesFirst:
+    def __init__(self):
+        # apexlint: releases(_seg, unlink<close)
+        self._seg = shared_memory.SharedMemory(create=True, size=64)
+
+    def destroy(self):
+        self._seg.close()
+        self._seg.unlink()

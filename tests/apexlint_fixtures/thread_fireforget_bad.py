@@ -1,0 +1,20 @@
+"""thread-lifecycle calibration: the fire-and-forget case.
+
+The target consults a stop flag, but the Thread object is never
+retained — nothing can ever join it. Exactly one finding, at the
+construction line.
+"""
+
+import threading
+
+
+class FireAndForget:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def launch(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            pass

@@ -1,0 +1,43 @@
+"""thread-lifecycle calibration: the compliant shapes.
+
+A retained thread whose target consults a stop event and whose
+teardown reaches a bounded join; a registry-retained worker; and one
+deliberately detached reader carrying the waiver.
+"""
+
+import threading
+
+
+class GoodOwner:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+        self._workers = []
+        for _ in range(2):
+            w = threading.Thread(target=self._run, daemon=True)
+            self._workers.append(w)
+
+    def _run(self):
+        while not self._stop.is_set():
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._t.join(timeout=2.0)
+        for w in self._workers:
+            w.join(timeout=2.0)
+
+
+class DetachedOwner:
+    def __init__(self, conns):
+        for conn in conns:
+            # apexlint: detached(reader exits when its socket dies)
+            threading.Thread(target=reader, args=(conn,),
+                             daemon=True).start()
+
+
+def reader(conn):
+    while True:
+        data = conn.recv(4096)
+        if not data:
+            return

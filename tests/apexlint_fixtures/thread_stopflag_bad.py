@@ -1,0 +1,21 @@
+"""thread-lifecycle calibration: the missing-stop-flag case.
+
+Retained and joined (bounded), but the target loop consults nothing —
+only process death ends it. Exactly one finding, at the construction
+line.
+"""
+
+import threading
+
+
+class Unstoppable:
+    def __init__(self):
+        self._n = 0
+        self._t = threading.Thread(target=self._spin, daemon=True)
+
+    def _spin(self):
+        while True:
+            self._n += 1
+
+    def teardown(self):
+        self._t.join(timeout=2.0)

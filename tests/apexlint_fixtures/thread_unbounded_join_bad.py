@@ -1,0 +1,22 @@
+"""thread-lifecycle calibration: the unbounded-join case.
+
+Retained, stoppable — but stop() joins without a timeout, so a wedged
+worker wedges teardown (the PR 7 drain-hang class). Exactly one
+finding, at the join line.
+"""
+
+import threading
+
+
+class UnboundedJoiner:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._t.join()

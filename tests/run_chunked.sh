@@ -30,8 +30,25 @@ export APEX_COMPILE_LOG="${compile_log}"
 
 # Static-analysis gate first: cheap (stdlib-only, no jax import) and a
 # finding here usually explains the test failure that would follow.
+# The JSON is piped through a per_checker key assertion so a refactor
+# that silently drops a checker (v3's lifecycle/closure three
+# included) fails HERE, not in a review months later.
 echo "=== tools/apexlint"
-if ! python -m tools.apexlint ape_x_dqn_tpu/ --format=json; then
+lint_json="$(python -m tools.apexlint ape_x_dqn_tpu/ --format=json)"
+lint_rc=$?
+printf '%s\n' "${lint_json}"
+if [ "${lint_rc}" -ne 0 ] || ! printf '%s' "${lint_json}" | python -c '
+import json, sys
+summary = json.load(sys.stdin)
+required = {"guarded-by", "jit-purity", "wire-protocol", "obs-names",
+            "retry-annotation", "remediation-accounting",
+            "use-after-donate", "host-sync", "config-coverage",
+            "learner-parity", "thread-lifecycle", "resource-lifecycle",
+            "counter-closure"}
+missing = required - set(summary["per_checker"])
+if missing:
+    sys.exit(f"apexlint checkers missing from run: {sorted(missing)}")
+'; then
     fail=1
     failed_files+=("tools/apexlint")
 fi

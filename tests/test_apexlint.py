@@ -24,9 +24,10 @@ FIXTURES = os.path.join(REPO_ROOT, "tests", "apexlint_fixtures")
 sys.path.insert(0, REPO_ROOT)  # tools/ is repo-local, not installed
 
 from tools.apexlint import run as apexlint_run  # noqa: E402
-from tools.apexlint import config_coverage, guarded_by, host_sync, \
-    jit_purity, learner_parity, obs_names, remediation_accounting, \
-    retry_annotation, use_after_donate, wire_protocol  # noqa: E402
+from tools.apexlint import config_coverage, counter_closure, guarded_by, \
+    host_sync, jit_purity, learner_parity, obs_names, \
+    remediation_accounting, resource_lifecycle, retry_annotation, \
+    thread_lifecycle, use_after_donate, wire_protocol  # noqa: E402
 
 
 def _fx(name: str) -> str:
@@ -57,10 +58,18 @@ def test_cli_json_subprocess():
         "guarded-by", "jit-purity", "wire-protocol", "obs-names",
         "retry-annotation", "remediation-accounting",
         "use-after-donate", "host-sync",
-        "config-coverage", "learner-parity"}
-    # per-checker shape feeds bench.py's secondary.apexlint lane
+        "config-coverage", "learner-parity",
+        "thread-lifecycle", "resource-lifecycle", "counter-closure"}
+    # per-checker shape feeds bench.py's secondary.apexlint lane;
+    # "ms" is the wall-clock CI watches for a checker gone slow
     for counts in summary["per_checker"].values():
-        assert set(counts) == {"findings", "waivers"}
+        assert set(counts) == {"findings", "waivers", "ms"}
+        assert counts["ms"] >= 0
+    # the verified conservation laws ride the summary for the runtime
+    # hook; the package declares at least the cold-door and drop ones
+    exprs = {c["expr"] for c in summary["closures"]}
+    assert "_cold_evicted == _cold_stored + _cold_dropped" in exprs
+    assert "_dropped == _drop_reasons" in exprs
 
 
 def test_cli_sarif_subprocess():
@@ -74,7 +83,11 @@ def test_cli_sarif_subprocess():
     driver = sarif["runs"][0]["tool"]["driver"]
     assert driver["name"] == "apexlint"
     assert {r["id"] for r in driver["rules"]} >= {
-        "use-after-donate", "host-sync", "learner-parity"}
+        "use-after-donate", "host-sync", "learner-parity",
+        "thread-lifecycle", "resource-lifecycle", "counter-closure"}
+    # per-rule timing properties (satellite: CI spots a slow checker)
+    for r in driver["rules"]:
+        assert set(r["properties"]) == {"findings", "waivers", "ms"}
     assert sarif["runs"][0]["results"] == []
 
 
@@ -101,6 +114,18 @@ def test_cli_self_dogfood():
         capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "0 finding(s)" in out.stdout
+
+
+def test_cli_self_asserts_chaos_coverage():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.apexlint", "--self",
+         "--format=json"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads(out.stdout)
+    # the dogfood run must actually sweep the fault injectors — the
+    # thread/resource checkers exist for exactly that kind of code
+    assert summary["self_scope"]["tools/chaos"] >= 3
 
 
 def test_cli_text_nonzero_exit_on_findings(tmp_path):
@@ -593,6 +618,112 @@ def test_obs_names_kind_mismatch(tmp_path):
     res = obs_names.check([str(emit)], str(report))
     assert len(res.findings) == 1
     assert "listed as ctr but emitted as gauge" in res.findings[0].message
+
+
+# -- v3 checker calibration (thread/resource lifecycle, closures) ---------
+
+def test_thread_lifecycle_fixtures():
+    good = thread_lifecycle.check_paths([_fx("thread_good.py")])
+    assert good.findings == []
+    assert good.waivers == 1  # the deliberately detached reader
+
+    bad = thread_lifecycle.check_paths(
+        [_fx("thread_unbounded_join_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "thread-lifecycle"
+    assert "unbounded .join()" in f.message
+    assert f.line == 22  # the join line, not the construction
+
+
+def test_thread_lifecycle_stopflag_fixture():
+    bad = thread_lifecycle.check_paths([_fx("thread_stopflag_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "thread-lifecycle"
+    assert "never consults a stop signal" in f.message
+
+
+def test_thread_lifecycle_fireforget_fixture():
+    bad = thread_lifecycle.check_paths(
+        [_fx("thread_fireforget_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "thread-lifecycle"
+    assert "fire-and-forget" in f.message
+
+
+def test_resource_lifecycle_fixtures():
+    good = resource_lifecycle.check_paths([_fx("resource_good.py")])
+    assert good.findings == []
+    assert good.waivers == 1  # the caller-owned socket
+
+    bad = resource_lifecycle.check_paths([_fx("resource_order_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "resource-lifecycle"
+    assert "out of declared order" in f.message
+    assert "close() runs before unlink()" in f.message
+    assert "PR 18" in f.message
+
+
+def test_resource_lifecycle_leak_fixture():
+    bad = resource_lifecycle.check_paths([_fx("resource_leak_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "resource-lifecycle"
+    assert "defines no teardown method" in f.message
+
+
+def test_counter_closure_fixtures():
+    good = counter_closure.check_paths([_fx("closure_good.py")])
+    assert good.findings == []
+    assert good.waivers == 1  # the rebalance move outside the law
+
+    bad = counter_closure.check_paths([_fx("closure_leak_bad.py")])
+    assert len(bad.findings) == 1
+    f = bad.findings[0]
+    assert f.checker == "counter-closure"
+    assert "a path leaks (0 term bumps)" in f.message
+    assert f.line == 17  # the _evicted bump whose error path leaks
+
+
+def test_counter_closure_runtime_hook():
+    decls = counter_closure.declarations([_fx("closure_good.py")])
+    assert [d["expr"] for d in decls] == \
+        ["_evicted == _stored + _dropped"]
+    decl = decls[0]
+
+    class Ledger:
+        pass
+
+    obj = Ledger()
+    obj._evicted, obj._stored, obj._dropped = 5, 3, 2
+    counter_closure.check_object(obj, decl)  # holds: silent
+    obj._dropped = {"reset": 1, "timeout": 0}  # dict terms sum
+    obj._evicted = 4
+    counter_closure.check_object(obj, decl)
+    obj._evicted = 9
+    with pytest.raises(AssertionError) as ei:
+        counter_closure.check_object(obj, decl)
+    assert "_evicted == _stored + _dropped" in str(ei.value)
+
+
+def test_v3_fixed_modules_stay_clean():
+    """Regression pins for the real findings the seeding sweep fixed:
+    the unbounded actor join + fire-and-forget bp watchdog
+    (runtime/actor_host.py), the never-joined stall watchdog
+    (obs/health.py), the undrained ingest queue
+    (comm/socket_transport.py), and the teardown-less loopback queue
+    (comm/transport.py). Single-file re-lints keep each fix honest
+    even if the package-wide gate's scope ever changes."""
+    pkg = os.path.join(REPO_ROOT, "ape_x_dqn_tpu")
+    for rel in ("runtime/actor_host.py", "obs/health.py"):
+        res = thread_lifecycle.check_paths([os.path.join(pkg, rel)])
+        assert res.findings == [], (rel, [str(f) for f in res.findings])
+    for rel in ("comm/socket_transport.py", "comm/transport.py"):
+        res = resource_lifecycle.check_paths([os.path.join(pkg, rel)])
+        assert res.findings == [], (rel, [str(f) for f in res.findings])
 
 
 # -- lock-order witness ---------------------------------------------------

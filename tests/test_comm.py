@@ -157,6 +157,44 @@ def test_server_get_params_caches_deserialized_tree():
         server.stop()
 
 
+def test_server_stop_drains_parked_batches():
+    """Regression (apexlint v3 resource-lifecycle sweep): stop() must
+    drain the bounded ingest queue and release() whatever is parked in
+    it — a batch stranded there at shutdown pins its resources (for an
+    shm slot batch, the ring slot AND the mapping; the PR 18 bug
+    class in queue form)."""
+    class Releasable(dict):
+        released = 0
+
+        def release(self):
+            type(self).released += 1
+
+    server = SocketIngestServer("127.0.0.1", 0)
+    try:
+        for i in range(3):
+            server.send_experience(Releasable(actor=i))
+        assert server._q.qsize() == 3
+    finally:
+        server.stop()
+    assert server._q.qsize() == 0
+    assert Releasable.released == 3
+
+
+def test_loopback_close_drains_queue():
+    """Regression (same sweep): LoopbackTransport gained close() so
+    batches parked in the bounded queue are not pinned by a transport
+    nobody will read again; drivers call close() symmetrically."""
+    from ape_x_dqn_tpu.comm.transport import LoopbackTransport
+
+    t = LoopbackTransport(max_pending=4)
+    for i in range(3):
+        t.send_experience({"actor": i})
+    assert t.pending == 3
+    t.close()
+    assert t.pending == 0
+    t.close()  # idempotent on an empty queue
+
+
 # -- wire codec (delta-deflate experience compression) ----------------------
 
 

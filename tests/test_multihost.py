@@ -158,6 +158,21 @@ def test_stall_watchdog_disabled_at_zero():
     wd.stop()
 
 
+def test_stall_watchdog_stop_joins_thread():
+    """Regression (apexlint v3 thread-lifecycle sweep): stop() must
+    JOIN the watch thread, not just set the event — a watcher still
+    running after stop() returns can fire a spurious diagnostic (or
+    the fatal) into interpreter teardown."""
+    from ape_x_dqn_tpu.runtime.multihost_driver import StallWatchdog
+
+    wd = StallWatchdog(30.0, describe=lambda: "",
+                       fatal=lambda c: None, emit=lambda m: None)
+    wd.start()
+    assert wd._thread.is_alive()
+    wd.stop()
+    assert not wd._thread.is_alive()
+
+
 def test_multihost_steps_per_frame_cap_binds():
     """learner.steps_per_frame_cap must pace the lockstep learner to
     the GLOBAL frame count (and the fleet must still terminate when the

@@ -12,7 +12,16 @@
 --self                     dogfood: lint tools/ itself with the
                            structural checkers (package-specific
                            tables — configs, README knobs, obs report
-                           — auto-skip when absent).
+                           — auto-skip when absent). Coverage of
+                           tools/chaos/ is asserted, not assumed: the
+                           run aborts if the walk found no chaos
+                           files, and the JSON summary carries
+                           `self_scope` with the per-subtree file
+                           counts.
+
+The JSON/SARIF summaries carry per-checker wall-clock (`ms`) so CI can
+spot a checker gone slow; SARIF rules expose findings/waivers/ms as
+rule properties.
 """
 
 from __future__ import annotations
@@ -31,7 +40,7 @@ SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
 
 
 def to_sarif(summary: dict) -> dict:
-    rules = sorted(summary["per_checker"])
+    per = summary["per_checker"]
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,
@@ -39,7 +48,12 @@ def to_sarif(summary: dict) -> dict:
             "tool": {"driver": {
                 "name": "apexlint",
                 "informationUri": "tools/apexlint",
-                "rules": [{"id": r} for r in rules],
+                "rules": [{"id": r,
+                           "properties": {
+                               "findings": per[r]["findings"],
+                               "waivers": per[r]["waivers"],
+                               "ms": per[r]["ms"],
+                           }} for r in sorted(per)],
             }},
             "results": [{
                 "ruleId": f["checker"],
@@ -76,7 +90,9 @@ def main(argv: list[str] | None = None) -> int:
         description="Ape-X project lint: guarded-by, jit-purity, "
                     "wire-protocol, obs-names, retry-annotation, "
                     "remediation-accounting, use-after-donate, "
-                    "host-sync, config-coverage, learner-parity.")
+                    "host-sync, config-coverage, learner-parity, "
+                    "thread-lifecycle, resource-lifecycle, "
+                    "counter-closure.")
     ap.add_argument("package", nargs="?", default=None,
                     help="package directory to scan (e.g. "
                          "ape_x_dqn_tpu/)")
@@ -95,6 +111,20 @@ def main(argv: list[str] | None = None) -> int:
         args.package = os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     summary = run(args.package)
+    if args.self_lint:
+        # chaos coverage is asserted, not assumed: the thread/resource
+        # checkers exist for exactly the kind of code tools/chaos holds
+        from tools.apexlint import package_files
+        chaos = os.path.normpath(os.path.join(args.package, "chaos"))
+        n_chaos = sum(
+            1 for p in package_files(args.package)
+            if os.path.normpath(p).startswith(chaos + os.sep))
+        if n_chaos == 0:
+            raise SystemExit(
+                "apexlint --self: tools/chaos/ contributed no files to "
+                "the scan — the dogfood run no longer covers the fault "
+                "injectors")
+        summary["self_scope"] = {"tools/chaos": n_chaos}
     if args.changed_only is not None:
         changed = changed_files(args.changed_only)
         summary["findings"] = [

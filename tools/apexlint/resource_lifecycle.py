@@ -1,0 +1,337 @@
+"""resource-lifecycle: OS resources must be released from teardown,
+in the declared order.
+
+PR 18 shipped (and fixed by hand) the whole bug family this checker
+exists for: a SharedMemory segment closed before it was unlinked pins
+the /dev/shm name forever; a probe segment on an error path leaks the
+name; a bounded queue dropped on the floor strands the memoryviews
+parked in it. The contract, per `self.X = <acquire>` site:
+
+- the owning class must define a teardown method (one of
+  close/stop/shutdown/retire/destroy/__exit__/__del__);
+- from some teardown root, walking self-method calls, a release of
+  `self.X` must be reachable:
+    shm     -> .close() or .unlink()
+    file    -> .close()
+    socket  -> .close() or .shutdown()
+    queue   -> any reference (drain loop, `put(None)` sentinel, .join)
+- an ordering declared at the acquire site with
+  `# apexlint: releases(X, unlink<close)` is verified against every
+  teardown root's linearized body (self-calls inlined): within one
+  root, `X.close()` must not precede `X.unlink()`.
+
+Acquire kinds are recognized structurally: `SharedMemory(...)`,
+`open(...)`, `socket.socket(...)` / `create_connection(...)`, and
+bounded `queue.Queue(maxsize=...)`. Factory indirection is followed
+through the call graph for shm/socket (e.g. `self._sock =
+self._connect()` where _connect returns a create_connection result) —
+but not for files/queues, where helpers routinely open-and-close
+internally.
+
+A `releases(...)` comment whose argument carries no `<` ordering is an
+out-of-band waiver ("caller owns teardown"), counted like any other
+waiver; ordering declarations are contracts, verified and not counted.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.apexlint.callgraph import (CallGraph, ClassInfo, FuncInfo,
+                                      ModuleInfo)
+from tools.apexlint.common import (CheckResult, Finding, ModuleSource,
+                                   dotted_name)
+
+CHECKER = "resource-lifecycle"
+WAIVER = "releases"
+
+TEARDOWN_NAMES = ("close", "stop", "shutdown", "retire", "destroy",
+                  "__exit__", "__del__")
+
+_RELEASE_OPS = {
+    "shm": ("close", "unlink"),
+    "file": ("close",),
+    "socket": ("close", "shutdown"),
+    # queue: any reference in teardown counts (drain / sentinel / join)
+    "queue": (),
+}
+
+
+def _direct_kind(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    if name.endswith("SharedMemory"):
+        return "shm"
+    if name in ("open", "io.open"):
+        return "file"
+    if name in ("socket.socket", "socket.create_connection",
+                "create_connection"):
+        return "socket"
+    if name in ("queue.Queue", "Queue") and (
+            call.args or any(kw.arg == "maxsize" for kw in call.keywords)):
+        return "queue"
+    return None
+
+
+def _acquire_kind(graph: CallGraph, mod: ModuleInfo,
+                  cls: ClassInfo | None, call: ast.Call,
+                  depth: int = 0) -> str | None:
+    kind = _direct_kind(call)
+    if kind is not None:
+        return kind
+    if depth >= 3:
+        return None
+    resolved = graph.resolve_call(call, mod, cls)
+    if not isinstance(resolved, FuncInfo):
+        return None
+    # factory indirection: only connection-shaped kinds (shm/socket);
+    # file/queue helpers routinely acquire-and-release internally
+    for n in ast.walk(resolved.node):
+        if isinstance(n, ast.Call):
+            k = _direct_kind(n)
+            if k in ("shm", "socket"):
+                return k
+    for n in ast.walk(resolved.node):
+        if isinstance(n, ast.Call):
+            k = _acquire_kind(graph, resolved.module, resolved.cls, n,
+                              depth + 1)
+            if k in ("shm", "socket"):
+                return k
+    return None
+
+
+def _self_attr_base(node: ast.expr) -> str | None:
+    """'X' when node is a (possibly chained) `self.X...` expression."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self":
+            return node.attr
+        node = node.value
+    return None
+
+
+def _releases_annotation(src: ModuleSource, node: ast.AST
+                         ) -> tuple[str | None, list[tuple[str, str]]]:
+    """(waiver_text, [(first_op, second_op), ...]) from a
+    `# apexlint: releases(...)` on the acquire statement's lines.
+    Orderings (`a<b`) make it a verified declaration; anything else
+    makes it an out-of-band waiver."""
+    # the line directly above the acquire counts too — multi-line
+    # constructor calls rarely leave room for a trailing annotation
+    for line in range(node.lineno - 1,
+                      (getattr(node, "end_lineno", None)
+                       or node.lineno) + 1):
+        arg = src.waiver(line, WAIVER)
+        if arg is None:
+            continue
+        orders = []
+        free = []
+        for part in arg.split(","):
+            part = part.strip()
+            if "<" in part:
+                a, b = part.split("<", 1)
+                orders.append((a.strip(), b.strip()))
+            elif part:
+                free.append(part)
+        if orders:
+            return None, orders  # declaration (the leading name is doc)
+        return (arg or "waived"), []
+    return None, []
+
+
+class _Acquire:
+    def __init__(self, cls: ClassInfo, attr: str, kind: str, line: int,
+                 orders: list[tuple[str, str]]):
+        self.cls = cls
+        self.attr = attr
+        self.kind = kind
+        self.line = line
+        self.orders = orders
+
+
+def _method_calls_on(body: ast.AST, attr: str) -> list[tuple[str, int]]:
+    """(op, line) for every `self.<attr>....op(...)` call in a scope,
+    including one level of local aliasing: a local bound from an
+    expression that mentions self.<attr> (`s = self._sock`,
+    `for s in (self._sock, self._psock):`) carries the attr, so
+    `s.close()` counts as a release of it — the canonical teardown
+    shape `for s in (...): s.close()`."""
+    aliases: set[str] = set()
+    for n in ast.walk(body):
+        src_expr = None
+        tgt = None
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            src_expr, tgt = n.value, n.targets[0]
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            src_expr, tgt = n.iter, n.target
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            src_expr, tgt = n.context_expr, n.optional_vars
+        if src_expr is None or not isinstance(tgt, ast.Name):
+            continue
+        if any(isinstance(m, ast.Attribute) and isinstance(
+                m.value, ast.Name) and m.value.id == "self"
+                and m.attr == attr for m in ast.walk(src_expr)):
+            aliases.add(tgt.id)
+    out = []
+    for n in ast.walk(body):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            recv = n.func.value
+            if _self_attr_base(recv) == attr or (
+                    isinstance(recv, ast.Name) and recv.id in aliases):
+                out.append((n.func.attr, n.lineno))
+    return out
+
+
+def _mentions_attr(body: ast.AST, attr: str) -> bool:
+    for n in ast.walk(body):
+        if isinstance(n, ast.Attribute) and isinstance(
+                n.value, ast.Name) and n.value.id == "self" \
+                and n.attr == attr:
+            return True
+    return False
+
+
+def _reachable_from_teardown(graph: CallGraph, cls: ClassInfo
+                             ) -> dict[str, FuncInfo]:
+    table = graph.method_table(cls)
+    roots = [n for n in TEARDOWN_NAMES if n in table]
+    seen: dict[str, FuncInfo] = {}
+    work = list(roots)
+    while work:
+        name = work.pop()
+        if name in seen or name not in table:
+            continue
+        seen[name] = table[name]
+        for n in ast.walk(table[name].node):
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name) and n.func.value.id == "self":
+                work.append(n.func.attr)
+    return seen
+
+
+def _linearized_ops(graph: CallGraph, cls: ClassInfo, root: FuncInfo,
+                    attr: str) -> list[str]:
+    """Ops on self.<attr> in source order through `root`, with
+    self-method calls inlined (depth-bounded, cycle-guarded)."""
+    table = graph.method_table(cls)
+    out: list[str] = []
+
+    def visit(node: ast.AST, stack: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                if isinstance(child.func, ast.Attribute):
+                    recv = child.func.value
+                    if _self_attr_base(recv) == attr:
+                        out.append(child.func.attr)
+                    elif (isinstance(recv, ast.Name)
+                          and recv.id == "self"
+                          and child.func.attr in table
+                          and child.func.attr not in stack
+                          and len(stack) < 6):
+                        callee = table[child.func.attr]
+                        visit(callee.node,
+                              stack + (child.func.attr,))
+            visit(child, stack)
+
+    visit(root.node, (root.name,))
+    return out
+
+
+def check_paths(paths: list[str]) -> CheckResult:
+    res = CheckResult()
+    sources = []
+    for p in paths:
+        try:
+            sources.append(ModuleSource(p))
+        except (SyntaxError, OSError):
+            continue
+    graph = CallGraph(sources)
+    for mod in graph.modules:
+        for cls in mod.classes.values():
+            _check_class(graph, mod, cls, res)
+    return res
+
+
+def _check_class(graph: CallGraph, mod: ModuleInfo, cls: ClassInfo,
+                 res: CheckResult) -> None:
+    src = mod.src
+    acquires: list[_Acquire] = []
+    for meth in cls.methods.values():
+        for stmt in ast.walk(meth.node):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)) \
+                    or not isinstance(
+                        getattr(stmt, "value", None), ast.Call):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            attrs = [t.attr for t in targets
+                     if isinstance(t, ast.Attribute)
+                     and isinstance(t.value, ast.Name)
+                     and t.value.id == "self"]
+            if not attrs:
+                continue
+            kind = _acquire_kind(graph, mod, cls, stmt.value)
+            if kind is None:
+                continue
+            waived, orders = _releases_annotation(src, stmt)
+            if waived is not None:
+                res.waivers += 1
+                continue
+            acquires.append(_Acquire(cls, attrs[0], kind, stmt.lineno,
+                                     orders))
+    if not acquires:
+        return
+
+    reachable = _reachable_from_teardown(graph, cls)
+    table = graph.method_table(cls)
+    roots = [table[n] for n in TEARDOWN_NAMES if n in table]
+
+    for acq in acquires:
+        if not roots:
+            res.findings.append(Finding(
+                CHECKER, src.path, acq.line,
+                f"{cls.name} holds a {acq.kind} in self.{acq.attr} but "
+                f"defines no teardown method "
+                f"({'/'.join(TEARDOWN_NAMES[:5])}) — the resource "
+                "leaks by construction; add one or waive with "
+                "# apexlint: releases(reason)"))
+            continue
+        release_ops = _RELEASE_OPS[acq.kind]
+        released = False
+        for meth in reachable.values():
+            if release_ops:
+                if any(op in release_ops for op, _ in
+                       _method_calls_on(meth.node, acq.attr)):
+                    released = True
+                    break
+            elif _mentions_attr(meth.node, acq.attr):
+                released = True  # queue: drained / sentineled / joined
+                break
+        if not released:
+            want = ("/".join(release_ops) if release_ops
+                    else "a drain or sentinel")
+            res.findings.append(Finding(
+                CHECKER, src.path, acq.line,
+                f"self.{acq.attr} ({acq.kind}) has no release ({want}) "
+                f"reachable from any teardown method of {cls.name} "
+                f"({', '.join(sorted(reachable))}) — released objects "
+                "stranded at shutdown; waive with "
+                "# apexlint: releases(reason)"))
+            continue
+        for first, second in acq.orders:
+            for root in roots:
+                ops = _linearized_ops(graph, cls, root, acq.attr)
+                if first in ops and second in ops and \
+                        ops.index(second) < ops.index(first):
+                    res.findings.append(Finding(
+                        CHECKER, src.path, acq.line,
+                        f"teardown '{root.name}' releases "
+                        f"self.{acq.attr} out of declared order: "
+                        f"{second}() runs before {first}() (declared "
+                        f"releases({acq.attr}, {first}<{second})) — "
+                        "the PR 18 close-pins-mapping class"))

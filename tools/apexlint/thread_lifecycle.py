@@ -1,0 +1,386 @@
+"""thread-lifecycle: every thread must be stoppable, owned, and joined.
+
+The fleet runs ~21 `threading.Thread` sites across 9 modules; PR 7's
+wedged-thread drain hang was exactly a thread nobody could join on
+teardown. The contract, per construction site:
+
+- **retained**: the Thread object lands in an attribute, a registry
+  (`self._slots[i] = t`, `self._threads.append(t)`), or a local that
+  the same function later joins. A bare
+  `threading.Thread(...).start()` is fire-and-forget — nothing can
+  ever join it.
+- **stoppable**: the resolved target function consults a stop signal
+  (an `Event.is_set()`/`.wait()`, a stop-ish flag read, or an
+  `is None` queue sentinel). A loop only the process's death can end
+  is a wedge waiting for a watchdog.
+- **joined, bounded**: somewhere in the owning scope (the class's
+  methods for attribute retention, the enclosing function for locals)
+  the thread is joined; every thread-shaped `.join()` must carry
+  `timeout=` — an unbounded join converts a wedged worker into a
+  wedged teardown (the PR 7 bug class).
+
+Waive a deliberately detached thread at the construction (or join)
+line — or on the comment line directly above it — with
+`# apexlint: detached(reason)`; e.g. per-connection reader threads
+that exit when their socket dies.
+
+Heuristic edges, chosen to stay quiet on the real package: a thread
+returned from a factory escapes ownership analysis (opaque); a target
+that cannot be resolved through the call graph is not accused of
+missing a stop signal; `"sep".join(parts)` is distinguished from
+thread joins by call shape (thread joins take no positional args).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.apexlint.callgraph import CallGraph, ClassInfo, ModuleInfo
+from tools.apexlint.common import (CheckResult, Finding, ModuleSource,
+                                   dotted_name)
+
+CHECKER = "thread-lifecycle"
+WAIVER = "detached"
+
+# identifier substrings that read as a shutdown flag consult
+_STOP_HINTS = ("stop", "done", "shutdown", "halt", "closed", "quit",
+               "retire", "drain", "exit", "running", "alive")
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name in ("threading.Thread", "Thread")
+
+
+def _kwarg(call: ast.Call, name: str) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    v = _kwarg(call, "daemon")
+    return isinstance(v, ast.Constant) and bool(v.value)
+
+
+def _span_waived(src: ModuleSource, node: ast.AST) -> bool:
+    # the line above the node counts too: Thread(...) constructions
+    # rarely leave room for a trailing justification
+    for line in range(node.lineno - 1,
+                      (getattr(node, "end_lineno", None)
+                       or node.lineno) + 1):
+        if src.waiver(line, WAIVER) is not None:
+            return True
+    return False
+
+
+def _base_attr(node: ast.expr) -> tuple[str | None, str | None]:
+    """For a (possibly subscripted) store target: ('self', attr) for
+    `self.X` / `self.X[i]`, (name, None) for `n` / `n[i]`, else
+    (None, None)."""
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            return "self", node.attr
+        return None, None
+    if isinstance(node, ast.Name):
+        return node.id, None
+    return None, None
+
+
+def _contains(node: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(node))
+
+
+def _binding(stmt: ast.stmt, call: ast.Call
+             ) -> tuple[str, str] | None:
+    """How the constructed Thread is retained:
+    ('attr', X)    stored on self (incl. registries self.X[i] = t)
+    ('local', n)   bound to / appended onto a local name
+    ('escape', '') returned or yielded: ownership leaves this scope
+    None           not retained at all (fire-and-forget)
+    """
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                base, attr = _base_attr(e)
+                if base == "self" and attr:
+                    return ("attr", attr)
+                if base and base != "self":
+                    return ("local", base)
+        return None
+    if isinstance(stmt, (ast.Return, ast.Expr)) and isinstance(
+            getattr(stmt, "value", None), ast.Yield):
+        return ("escape", "")
+    if isinstance(stmt, ast.Return):
+        return ("escape", "")
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        outer = stmt.value
+        # self.X.append(t) / registry.add(t): retained in the receiver
+        if (isinstance(outer.func, ast.Attribute)
+                and outer.func.attr in ("append", "add", "insert")
+                and any(_contains(a, call) for a in outer.args)):
+            base, attr = _base_attr(outer.func.value)
+            if base == "self" and attr:
+                return ("attr", attr)
+            if base and base != "self":
+                return ("local", base)
+        # threading.Thread(...).start(): the classic fire-and-forget
+        return None
+    return None
+
+
+def _local_escape(fnode: ast.AST, name: str) -> str | None:
+    """Where a local thread escapes its function: an attr name when it
+    is stored on self (`self.X = t`, `self.X[i] = t`,
+    `self.X.append(t)`), '<return>' when returned, else None."""
+    def is_name(e: ast.expr) -> bool:
+        return isinstance(e, ast.Name) and e.id == name
+    returned = False
+    for n in ast.walk(fnode):
+        if isinstance(n, ast.Assign) and is_name(n.value):
+            for t in n.targets:
+                base, attr = _base_attr(t)
+                if base == "self" and attr:
+                    return attr  # retention beats a convenience return
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in ("append", "add", "insert") \
+                and any(is_name(a) for a in n.args):
+            base, attr = _base_attr(n.func.value)
+            if base == "self" and attr:
+                return attr
+        if isinstance(n, ast.Return) and n.value is not None and (
+                is_name(n.value) or (
+                    isinstance(n.value, (ast.Tuple, ast.List))
+                    and any(is_name(e) for e in n.value.elts))):
+            returned = True
+    return "<return>" if returned else None
+
+
+def _shallow_walk(root: ast.AST):
+    """Walk `root` without descending into nested function/class
+    bodies — the per-function view `_functions_with_context` already
+    yields those as their own entries."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            yield child
+            stack.append(child)
+
+
+def _thread_joins(scope: ast.AST) -> list[ast.Call]:
+    """Thread-shaped `.join(...)` calls in a scope: no positional args
+    (string joins always pass the iterable positionally)."""
+    out = []
+    for n in ast.walk(scope):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "join" and not n.args):
+            out.append(n)
+    return out
+
+
+def _consults_stop(fnode: ast.AST, graph: CallGraph | None = None,
+                   cls: ClassInfo | None = None, depth: int = 0) -> bool:
+    saw_none_check = saw_break = False
+    for n in ast.walk(fnode):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr in ("is_set", "wait")):
+            return True
+        ident = None
+        if isinstance(n, ast.Name):
+            ident = n.id
+        elif isinstance(n, ast.Attribute):
+            ident = n.attr
+        if ident and any(h in ident.lower() for h in _STOP_HINTS):
+            return True
+        if isinstance(n, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops) \
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in [n.left] + list(n.comparators)):
+            saw_none_check = True
+        if isinstance(n, (ast.Break, ast.Return)):
+            saw_break = True
+    if saw_none_check and saw_break:  # `if item is None: break` sentinel
+        return True
+    # thin wrappers (`def _loop(self): self._loop_inner()`) delegate
+    # the consult one call down — follow self-method calls two hops
+    if graph is not None and cls is not None and depth < 2:
+        for n in ast.walk(fnode):
+            if isinstance(n, ast.Call) and isinstance(
+                    n.func, ast.Attribute) and isinstance(
+                    n.func.value, ast.Name) and n.func.value.id == "self":
+                m = graph.lookup_method(cls, n.func.attr)
+                if m is not None and _consults_stop(
+                        m.node, graph, cls, depth + 1):
+                    return True
+    return False
+
+
+def _resolve_target(graph: CallGraph, mod: ModuleInfo,
+                    cls: ClassInfo | None, func_node: ast.AST,
+                    target: ast.expr) -> tuple[str, ast.AST | None]:
+    """(display name, resolved function node or None if opaque)."""
+    if isinstance(target, ast.Lambda):
+        return "<lambda>", target
+    if isinstance(target, ast.Name):
+        for n in ast.walk(func_node):  # nested worker defs first
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == target.id:
+                return target.id, n
+        resolved = graph.resolve_symbol(mod, target.id)
+        node = getattr(resolved, "node", None)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return target.id, node
+        return target.id, None
+    name = dotted_name(target)
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self" and cls is not None):
+        m = graph.lookup_method(cls, target.attr)
+        return name or target.attr, (m.node if m else None)
+    return name or "<dynamic>", None
+
+
+def _enclosing_stmt(func_node: ast.AST, call: ast.Call) -> ast.stmt | None:
+    """Innermost statement inside `func_node` containing `call`."""
+    best: ast.stmt | None = None
+    for n in ast.walk(func_node):
+        if isinstance(n, ast.stmt) and _contains(n, call):
+            if best is None or _contains(best, n):
+                best = n
+    return best
+
+
+def _class_info(mod: ModuleInfo, cls_node: ast.ClassDef | None
+                ) -> ClassInfo | None:
+    if cls_node is None:
+        return None
+    return mod.classes.get(cls_node.name)
+
+
+def check_paths(paths: list[str]) -> CheckResult:
+    res = CheckResult()
+    sources = []
+    for p in paths:
+        try:
+            sources.append(ModuleSource(p))
+        except (SyntaxError, OSError):
+            continue
+    graph = CallGraph(sources)
+    for mod in graph.modules:
+        _check_module(graph, mod, res)
+    return res
+
+
+def _functions_with_context(tree: ast.Module):
+    """Yield (func_node, enclosing ClassDef | None) for every function,
+    attributing nested defs to their outermost enclosing function's
+    class."""
+    def visit(node: ast.AST, cls: ast.ClassDef | None):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from visit(child, cls)
+            else:
+                yield from visit(child, cls)
+    yield from visit(tree, None)
+
+
+def _check_module(graph: CallGraph, mod: ModuleInfo,
+                  res: CheckResult) -> None:
+    src = mod.src
+    funcs = list(_functions_with_context(src.tree))
+
+    # -- unbounded thread joins, anywhere ------------------------------
+    # (one flat walk of the module tree sees each join exactly once)
+    for jn in _thread_joins(src.tree):
+        if _kwarg(jn, "timeout") is not None:
+            continue
+        if _span_waived(src, jn):
+            res.waivers += 1
+            continue
+        res.findings.append(Finding(
+            CHECKER, src.path, jn.lineno,
+            "unbounded .join() — a wedged thread turns this into a "
+            "wedged teardown; use join(timeout=...) or waive with "
+            "# apexlint: detached(reason)"))
+
+    # -- construction sites --------------------------------------------
+    # the shallow walk attributes each call to exactly its innermost
+    # enclosing function (nested defs are separate `funcs` entries)
+    for fnode, cls_node in funcs:
+        cls = _class_info(mod, cls_node)
+        for call in _shallow_walk(fnode):
+            if not (isinstance(call, ast.Call) and _is_thread_ctor(call)):
+                continue
+            if _span_waived(src, call):
+                res.waivers += 1
+                continue
+
+            target = _kwarg(call, "target") or (
+                call.args[0] if call.args else None)
+            tname, tnode = ("<none>", None) if target is None else \
+                _resolve_target(graph, mod, cls, fnode, target)
+            if tnode is not None and not _consults_stop(tnode, graph,
+                                                        cls):
+                res.findings.append(Finding(
+                    CHECKER, src.path, call.lineno,
+                    f"thread target '{tname}' never consults a stop "
+                    "signal (Event.is_set()/.wait(), a stop-ish flag, "
+                    "or an `is None` sentinel) — the owner cannot shut "
+                    "it down; waive with # apexlint: detached(reason)"))
+
+            stmt = _enclosing_stmt(fnode, call)
+            bind = _binding(stmt, call) if stmt is not None else None
+            if bind is None:
+                kind = "daemon" if _is_daemon(call) else "NON-daemon"
+                res.findings.append(Finding(
+                    CHECKER, src.path, call.lineno,
+                    f"fire-and-forget {kind} thread: never retained in "
+                    "an attribute, registry, or joined local — nothing "
+                    "can join it on teardown; waive with "
+                    "# apexlint: detached(reason)"))
+                continue
+            how, name = bind
+            if how == "local":
+                # a local that lands in a self registry afterwards
+                # (`t = Thread(...); self._slots[i] = t`) is class-
+                # retained; one that is returned escapes to the caller
+                escaped_attr = _local_escape(fnode, name)
+                if escaped_attr == "<return>":
+                    how = "escape"
+                elif escaped_attr is not None:
+                    how, name = "attr", escaped_attr
+            if how == "escape":
+                continue  # factory hands ownership to the caller
+            if how == "attr":
+                scope_nodes = ([m.node for m in
+                                graph.method_table(cls).values()]
+                               if cls is not None else [fnode])
+                where = (f"any method of {cls.name}" if cls is not None
+                         else "the enclosing scope")
+            else:
+                scope_nodes = [fnode]
+                where = f"function '{getattr(fnode, 'name', '?')}'"
+            joins = [j for s in scope_nodes for j in _thread_joins(s)]
+            if not joins:
+                held = (f"self.{name}" if how == "attr" else
+                        f"local '{name}'")
+                res.findings.append(Finding(
+                    CHECKER, src.path, call.lineno,
+                    f"thread retained in {held} is never joined in "
+                    f"{where} — teardown (close/stop/shutdown/retire) "
+                    "must reach a bounded join(timeout=...); waive "
+                    "with # apexlint: detached(reason)"))

@@ -147,6 +147,7 @@ class ChaosProxy:
                 self._stats["connections"] += 1
             for src, dst, tag in ((client, server, "c2s"),
                                   (server, client, "s2c")):
+                # apexlint: detached(pumps die with their sockets; stop() closes every _live socket)
                 threading.Thread(target=self._pump, args=(src, dst),
                                  name=f"chaos-{tag}", daemon=True).start()
 
